@@ -1,0 +1,104 @@
+"""deepspeed_tpu — a TPU-native distributed training & inference framework
+with the capability surface of DeepSpeed v0.9.3 (reference
+``deepspeed/__init__.py``), re-designed for JAX/XLA/Pallas/pjit.
+
+Top-level API parity:
+
+* ``initialize()``          (reference ``__init__.py:58``)
+* ``init_inference()``      (reference ``__init__.py:260``)
+* ``init_distributed``      (re-export, reference ``__init__.py:32``)
+* ``add_config_arguments()``(reference ``__init__.py:237``)
+"""
+
+__version__ = "0.1.0"
+__git_hash__ = None
+__git_branch__ = None
+
+from deepspeed_tpu.accelerator import get_accelerator, set_accelerator  # noqa: F401
+from deepspeed_tpu import comm  # noqa: F401
+from deepspeed_tpu.comm import init_distributed  # noqa: F401
+from deepspeed_tpu.parallel import topology  # noqa: F401
+from deepspeed_tpu.parallel.topology import ParallelTopology, initialize_topology  # noqa: F401
+from deepspeed_tpu.runtime.config import DeepSpeedConfig  # noqa: F401
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.utils.logging import logger, log_dist  # noqa: F401
+
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam, FusedAdamW  # noqa: F401
+from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb  # noqa: F401
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mpu=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               config_params=None,
+               loss_fn=None,
+               topology=None):
+    """Initialize the engine (reference ``deepspeed/__init__.py:58``).
+
+    Returns the tuple ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+    ``model`` is a flax Module or ``apply_fn(params, batch) -> loss``;
+    ``model_parameters`` an optional initial parameter pytree (else params are
+    lazily initialized *sharded* at first forward).  The engine choice
+    (plain vs pipeline) mirrors reference ``__init__.py:150-190``.
+    """
+    if config is None and config_params is not None:
+        config = config_params
+    if config is None and args is not None and hasattr(args, "deepspeed_config"):
+        config = args.deepspeed_config
+    assert config is not None, "DeepSpeed requires --deepspeed_config or config="
+
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
+    if isinstance(model, PipelineModule):
+        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+        engine = PipelineEngine(model=model,
+                                optimizer=optimizer,
+                                model_parameters=model_parameters,
+                                training_data=training_data,
+                                lr_scheduler=lr_scheduler,
+                                collate_fn=collate_fn,
+                                config=config,
+                                topology=topology)
+    else:
+        engine = DeepSpeedEngine(model=model,
+                                 optimizer=optimizer,
+                                 model_parameters=model_parameters,
+                                 training_data=training_data,
+                                 lr_scheduler=lr_scheduler,
+                                 collate_fn=collate_fn,
+                                 config=config,
+                                 loss_fn=loss_fn,
+                                 topology=topology)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Initialize the inference engine (reference ``__init__.py:260``)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    if isinstance(config, dict):
+        config = DeepSpeedInferenceConfig(**config, **kwargs)
+    elif config is None:
+        config = DeepSpeedInferenceConfig(**kwargs)
+    return InferenceEngine(model, config)
+
+
+def add_config_arguments(parser):
+    """Add --deepspeed / --deepspeed_config CLI args (reference
+    ``__init__.py:237``)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag to launcher)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to DeepSpeed json configuration")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help="Deprecated alias of --deepspeed")
+    group.add_argument("--local_rank", type=int, default=-1,
+                       help="local rank passed by the launcher")
+    return parser

@@ -1,0 +1,3 @@
+from .abstract_accelerator import Accelerator
+from .real_accelerator import get_accelerator, set_accelerator
+from .tpu_accelerator import TPU_Accelerator, CPU_Accelerator

@@ -1,0 +1,41 @@
+"""Accelerator selection.
+
+Analog of the reference's ``accelerator/real_accelerator.py:37,55``
+(``get_accelerator``/``set_accelerator``): pick the accelerator from the
+runtime platform (TPU if present, else CPU simulation), overridable via the
+``DSTPU_ACCELERATOR`` env var or ``set_accelerator()``.
+"""
+
+import os
+
+_accelerator = None
+
+
+def _detect_platform():
+    override = os.environ.get("DSTPU_ACCELERATOR")
+    if override:
+        return override
+    import jax
+    try:
+        platform = jax.default_backend()
+    except RuntimeError:
+        return "cpu"
+    # 'axon' is a tunneled TPU platform; treat any non-cpu backend as TPU-like.
+    return "cpu" if platform == "cpu" else "tpu"
+
+
+def get_accelerator():
+    global _accelerator
+    if _accelerator is None:
+        from .tpu_accelerator import TPU_Accelerator, CPU_Accelerator
+        if _detect_platform() == "cpu":
+            _accelerator = CPU_Accelerator()
+        else:
+            _accelerator = TPU_Accelerator()
+    return _accelerator
+
+
+def set_accelerator(accel):
+    global _accelerator
+    _accelerator = accel
+    return _accelerator

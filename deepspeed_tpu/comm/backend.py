@@ -1,0 +1,72 @@
+"""Communication backend ABC + XLA backend.
+
+Analog of the reference's ``deepspeed/comm/backend.py:25`` (``Backend`` ABC)
+and ``deepspeed/comm/torch.py:39`` (``TorchBackend``).  The only production
+backend here is ``XlaBackend``: collective verbs lower to ``jax.lax``
+collectives over mesh axes (ICI/DCN), with process bootstrap via
+``jax.distributed.initialize``.
+"""
+
+import os
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Backend:
+
+    def __init__(self, name="backend", rank=0, size=1):
+        self.name = name
+        self.initialized = False
+
+    def is_initialized(self):
+        return self.initialized
+
+    def init_process_group(self):
+        self.initialized = True
+
+    def destroy_process_group(self):
+        self.initialized = False
+
+
+class XlaBackend(Backend):
+    """Multi-host bootstrap + rank discovery over the JAX runtime.
+
+    The reference's ``TorchBackend.init_process_group`` (``comm/torch.py:84``)
+    rendezvouses via MASTER_ADDR/PORT; the JAX runtime does the same through
+    ``jax.distributed.initialize`` using the coordinator address.  On a single
+    process (or under a CPU-simulated mesh) no bootstrap is needed.
+    """
+
+    def __init__(self, timeout=None, init_method=None):
+        super().__init__(name="xla")
+        self.timeout = timeout
+        self.init_method = init_method
+
+    def init_process_group(self):
+        import jax
+        if self.initialized:
+            return
+        coordinator = os.environ.get("DSTPU_COORDINATOR_ADDRESS")
+        num_processes = os.environ.get("DSTPU_NUM_PROCESSES")
+        process_id = os.environ.get("DSTPU_PROCESS_ID")
+        if coordinator is not None:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=int(num_processes) if num_processes else None,
+                process_id=int(process_id) if process_id else None,
+            )
+            logger.info(
+                f"jax.distributed initialized: process {jax.process_index()}"
+                f"/{jax.process_count()} via {coordinator}")
+        elif os.environ.get("COORDINATOR_ADDRESS") or int(os.environ.get("DSTPU_AUTO_DIST", "0")):
+            # TPU pod slices auto-discover through the TPU runtime metadata.
+            jax.distributed.initialize()
+        self.initialized = True
+
+    def get_rank(self):
+        import jax
+        return jax.process_index()
+
+    def get_world_size(self):
+        import jax
+        return jax.process_count()

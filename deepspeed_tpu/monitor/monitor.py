@@ -1,0 +1,116 @@
+"""Experiment monitoring — parity with reference ``deepspeed/monitor/``:
+``Monitor`` ABC (``monitor.py:13``), ``MonitorMaster`` fan-out
+(``monitor.py:29``) over TensorBoard / WandB / CSV backends.
+
+Events are ``(name, value, global_step)`` tuples via ``write_events``,
+exactly the reference protocol, so engine-side call sites port 1:1."""
+
+import os
+import csv as _csv
+from abc import ABC, abstractmethod
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class Monitor(ABC):
+
+    def __init__(self, monitor_config):
+        self.monitor_config = monitor_config
+
+    @abstractmethod
+    def write_events(self, event_list):
+        ...
+
+
+class TensorBoardMonitor(Monitor):
+
+    def __init__(self, tensorboard_config):
+        super().__init__(tensorboard_config)
+        self.enabled = tensorboard_config.enabled
+        self.summary_writer = None
+        if self.enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                log_dir = os.path.join(tensorboard_config.output_path or "./runs",
+                                       tensorboard_config.job_name)
+                self.summary_writer = SummaryWriter(log_dir=log_dir)
+            except ImportError:
+                logger.warning("tensorboard not available; TensorBoardMonitor disabled")
+                self.enabled = False
+
+    def write_events(self, event_list, flush=True):
+        if not self.enabled or self.summary_writer is None:
+            return
+        for event in event_list:
+            self.summary_writer.add_scalar(*event)
+        if flush:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+
+    def __init__(self, wandb_config):
+        super().__init__(wandb_config)
+        self.enabled = wandb_config.enabled
+        if self.enabled:
+            try:
+                import wandb
+                self.wandb = wandb
+                wandb.init(project=wandb_config.project, group=wandb_config.group,
+                           entity=wandb_config.team)
+            except ImportError:
+                logger.warning("wandb not available; WandbMonitor disabled")
+                self.enabled = False
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            self.wandb.log({name: value}, step=int(step))
+
+
+class csvMonitor(Monitor):
+
+    def __init__(self, csv_config):
+        super().__init__(csv_config)
+        self.enabled = csv_config.enabled
+        self.output_path = csv_config.output_path or "./csv_monitor"
+        self.job_name = csv_config.job_name
+        self.filehandles = {}
+        if self.enabled:
+            os.makedirs(os.path.join(self.output_path, self.job_name), exist_ok=True)
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            safe = name.replace("/", "_")
+            path = os.path.join(self.output_path, self.job_name, f"{safe}.csv")
+            new = not os.path.exists(path)
+            with open(path, "a", newline="") as f:
+                w = _csv.writer(f)
+                if new:
+                    w.writerow(["step", safe])
+                w.writerow([int(step), float(value)])
+
+
+class MonitorMaster(Monitor):
+    """Fan events out to all enabled backends; only JAX process 0 writes
+    (reference gates on rank 0, ``monitor.py:29``)."""
+
+    def __init__(self, monitor_config):
+        super().__init__(monitor_config)
+        import jax
+        self.enabled = monitor_config.enabled
+        self.backends = []
+        if jax.process_index() == 0:
+            if monitor_config.tensorboard.enabled:
+                self.backends.append(TensorBoardMonitor(monitor_config.tensorboard))
+            if monitor_config.wandb.enabled:
+                self.backends.append(WandbMonitor(monitor_config.wandb))
+            if monitor_config.csv_monitor.enabled:
+                self.backends.append(csvMonitor(monitor_config.csv_monitor))
+
+    def write_events(self, event_list):
+        for backend in self.backends:
+            backend.write_events(event_list)

@@ -1,0 +1,82 @@
+"""Fused Adam/AdamW.
+
+TPU-native equivalent of the reference's multi-tensor-apply CUDA Adam
+(``csrc/adam/multi_tensor_adam.cu`` behind ``deepspeed/ops/adam/fused_adam.py:18``).
+On TPU there is no separate "fused kernel" to write: the whole update below is
+jitted together with gradient production into ONE XLA program, so every
+moment/param update fuses into a handful of elementwise HLO loops over HBM —
+the same memory-bound optimum the multi-tensor kernel achieves on GPU.
+
+The optimizer is expressed functionally: ``init(params) -> state``,
+``update(grads, state, params, lr, step) -> (new_params, new_state)`` with
+``lr``/``step`` as traced scalars so LR schedules don't retrigger compilation.
+"""
+
+from typing import NamedTuple, Any
+
+import jax
+import jax.numpy as jnp
+
+
+class AdamState(NamedTuple):
+    exp_avg: Any       # first moment, same pytree as params
+    exp_avg_sq: Any    # second moment
+
+
+class FusedAdam:
+    """Adam/AdamW with bias correction (reference fused_adam.py:18 semantics:
+    ``adam_w_mode`` selects decoupled weight decay)."""
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                 adam_w_mode=True, bias_correction=True, amsgrad=False,
+                 master_dtype=jnp.float32):
+        if amsgrad:
+            raise ValueError("FusedAdam does not support amsgrad (parity with reference)")
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adam_w_mode = adam_w_mode
+        self.bias_correction = bias_correction
+        self.master_dtype = master_dtype
+
+    def init(self, params):
+        zeros = lambda p: jnp.zeros(p.shape, dtype=self.master_dtype)
+        return AdamState(exp_avg=jax.tree.map(zeros, params),
+                         exp_avg_sq=jax.tree.map(zeros, params))
+
+    def update(self, grads, state, params, lr=None, step=1):
+        lr = self.lr if lr is None else lr
+        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
+        step = jnp.asarray(step, dtype=jnp.float32)
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** step
+            bc2 = 1.0 - b2 ** step
+        else:
+            bc1 = bc2 = 1.0
+
+        def leaf(p, g, m, v):
+            g32 = g.astype(self.master_dtype)
+            p32 = p.astype(self.master_dtype)
+            if wd != 0.0 and not self.adam_w_mode:
+                g32 = g32 + wd * p32
+            m = b1 * m + (1.0 - b1) * g32
+            v = b2 * v + (1.0 - b2) * (g32 * g32)
+            denom = jnp.sqrt(v / bc2) + eps
+            upd = (m / bc1) / denom
+            if wd != 0.0 and self.adam_w_mode:
+                upd = upd + wd * p32
+            return (p32 - lr * upd).astype(p.dtype), m, v
+
+        out = jax.tree.map(leaf, params, grads, state.exp_avg, state.exp_avg_sq)
+        new_params = jax.tree.map(lambda t: t[0], out, is_leaf=lambda t: isinstance(t, tuple))
+        new_m = jax.tree.map(lambda t: t[1], out, is_leaf=lambda t: isinstance(t, tuple))
+        new_v = jax.tree.map(lambda t: t[2], out, is_leaf=lambda t: isinstance(t, tuple))
+        return new_params, AdamState(new_m, new_v)
+
+
+class FusedAdamW(FusedAdam):
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.01, **kw):
+        super().__init__(lr=lr, betas=betas, eps=eps, weight_decay=weight_decay,
+                         adam_w_mode=True, **kw)

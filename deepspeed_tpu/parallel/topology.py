@@ -1,0 +1,181 @@
+"""Device-mesh topology: the TPU-native replacement for process groups.
+
+The reference builds NCCL communicators per parallelism axis
+(``deepspeed/utils/groups.py:59,108,202`` for model/expert/expert-data groups,
+``deepspeed/runtime/pipe/topology.py:12,251`` for the pipeline rank grid).
+On TPU the same capability is one ``jax.sharding.Mesh`` whose named axes ARE
+the groups: collectives take an axis name instead of a communicator handle,
+and XLA lays the collective onto ICI/DCN from the mesh's device order.
+
+Axis order (outermost → innermost): ``pp, edp, ep, sp, tp``.
+``tp`` is innermost so tensor-parallel collectives ride the fastest ICI links;
+``pp`` is outermost so pipeline stages land on DCN-adjacent slices in
+multi-host meshes.  The data-parallel "group" is the compound axis
+``(edp, ep)`` — when expert parallelism is enabled, ``ep`` carves expert
+groups out of the DP world exactly like the reference
+(``groups.py:108 _create_expert_and_data_parallel``).
+"""
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+# Canonical axis names.
+PP_AXIS = "pp"      # pipeline stages
+EDP_AXIS = "edp"    # expert-data-parallel (DP within an expert group)
+EP_AXIS = "ep"      # expert parallel
+SP_AXIS = "sp"      # sequence/context parallel
+TP_AXIS = "tp"      # tensor/model parallel
+
+AXIS_ORDER = (PP_AXIS, EDP_AXIS, EP_AXIS, SP_AXIS, TP_AXIS)
+
+# Compound groups, named for parity with the reference group getters.
+DP_AXES = (EDP_AXIS, EP_AXIS)              # dense data-parallel group
+DENSE_GRAD_AXES = (EDP_AXIS, EP_AXIS, SP_AXIS)  # grad-reduction axes, dense params
+EXPERT_GRAD_AXES = (EDP_AXIS, SP_AXIS)          # grad-reduction axes, expert params
+
+
+@dataclass
+class ParallelTopology:
+    """A named device mesh plus the group algebra DeepSpeed exposes.
+
+    Analog of ``PipeModelDataParallelTopology`` (reference
+    ``runtime/pipe/topology.py:244``) generalized with expert and sequence
+    axes.
+    """
+
+    dp: int = 1
+    tp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    devices: list = field(default=None, repr=False)
+    mesh: Mesh = field(default=None, repr=False)
+
+    def __post_init__(self):
+        if self.dp % self.ep != 0:
+            raise ValueError(
+                f"expert parallel size {self.ep} must divide data parallel size {self.dp}")
+        self.edp = self.dp // self.ep
+        devices = self.devices
+        if devices is None:
+            devices = jax.devices()
+        need = self.world_size
+        if len(devices) < need:
+            raise ValueError(
+                f"topology dp={self.dp} tp={self.tp} pp={self.pp} sp={self.sp} "
+                f"needs {need} devices, have {len(devices)}")
+        devices = devices[:need]
+        if self.mesh is None:
+            shape = (self.pp, self.edp, self.ep, self.sp, self.tp)
+            try:
+                from jax.experimental import mesh_utils
+                dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+            except Exception:
+                dev_array = np.asarray(devices).reshape(shape)
+            self.mesh = Mesh(dev_array, AXIS_ORDER)
+
+    # ------------------------------------------------------------------ #
+    @property
+    def world_size(self):
+        return self.pp * self.edp * self.ep * self.sp * self.tp
+
+    # Group getters — parity with reference ``utils/groups.py:280-392``.
+    def get_data_parallel_axes(self):
+        return DP_AXES
+
+    def get_model_parallel_axes(self):
+        return (TP_AXIS,)
+
+    def get_pipe_parallel_axes(self):
+        return (PP_AXIS,)
+
+    def get_expert_parallel_axes(self):
+        return (EP_AXIS,)
+
+    def get_expert_data_parallel_axes(self):
+        return (EDP_AXIS,)
+
+    def get_sequence_parallel_axes(self):
+        return (SP_AXIS,)
+
+    def axis_size(self, name):
+        return self.mesh.shape[name]
+
+    def get_data_parallel_world_size(self):
+        return self.dp
+
+    def get_model_parallel_world_size(self):
+        return self.tp
+
+    def get_pipe_parallel_world_size(self):
+        return self.pp
+
+    def get_sequence_parallel_world_size(self):
+        return self.sp
+
+    def get_expert_parallel_world_size(self):
+        return self.ep
+
+    # ------------------------------------------------------------------ #
+    def batch_spec(self, extra_dims=0):
+        """PartitionSpec for a [batch, ...] array: batch sharded over DP
+        (and sequence over sp when present on dim 1)."""
+        dims = [DENSE_GRAD_AXES if self.dp > 1 or self.ep > 1 else None]
+        if self.sp > 1:
+            # With an active sp axis the batch dim carries (edp, ep) only and
+            # dim 1 (sequence) carries sp.
+            dims = [DP_AXES, SP_AXIS]
+        return P(*dims, *([None] * extra_dims))
+
+    def data_spec(self, batch_sharded=True, seq_dim=None):
+        """Spec for input batches: dim0 over DP; optional seq dim over sp."""
+        parts = [DP_AXES if batch_sharded else None]
+        if seq_dim == 1:
+            parts.append(SP_AXIS if self.sp > 1 else None)
+        return P(*parts)
+
+    def replicated_spec(self):
+        return P()
+
+
+# --------------------------------------------------------------------- #
+# Global topology registry — analog of the module-level group cache in
+# reference ``utils/groups.py``.
+# --------------------------------------------------------------------- #
+_TOPOLOGY = None
+
+
+def initialize_topology(dp=None, tp=1, pp=1, ep=1, sp=1, devices=None):
+    global _TOPOLOGY
+    if devices is None:
+        devices = jax.devices()
+    if dp is None:
+        denom = tp * pp * ep * sp
+        if len(devices) % denom != 0:
+            raise ValueError(
+                f"device count {len(devices)} not divisible by tp*pp*ep*sp={denom}")
+        dp = (len(devices) // denom) * ep  # dp includes the ep sub-axis
+    _TOPOLOGY = ParallelTopology(dp=dp, tp=tp, pp=pp, ep=ep, sp=sp, devices=devices)
+    return _TOPOLOGY
+
+
+def get_topology():
+    global _TOPOLOGY
+    if _TOPOLOGY is None:
+        _TOPOLOGY = initialize_topology()
+    return _TOPOLOGY
+
+
+def set_topology(topo):
+    global _TOPOLOGY
+    _TOPOLOGY = topo
+    return _TOPOLOGY
+
+
+def reset_topology():
+    global _TOPOLOGY
+    _TOPOLOGY = None

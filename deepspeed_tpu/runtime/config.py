@@ -1,0 +1,369 @@
+"""DeepSpeed-schema JSON config for the TPU engine.
+
+Analog of reference ``runtime/config.py:674`` (``DeepSpeedConfig``): one
+JSON/dict drives every subsystem.  Key names match the reference schema
+(``docs/_pages/config-json.md``) so existing DeepSpeed configs work unchanged;
+TPU-only knobs (mesh sizes, remat policy) are additive blocks.
+
+The batch-size triple (``train_batch_size = micro_batch * grad_accum *
+data-parallel world``) is auto-completed and validated exactly like the
+reference (``runtime/config.py`` _batch_assertion / _set_batch_related_parameters).
+"""
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.utils.logging import logger
+
+
+# --------------------------------------------------------------------- #
+# Subsystem config models
+# --------------------------------------------------------------------- #
+class FP16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0          # 0 → dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+
+
+class BF16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+
+
+class OffloadDeviceEnum:
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
+    device: str = "none"             # none | cpu | nvme
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    max_in_cpu: int = 1_000_000_000
+    pin_memory: bool = False
+
+
+class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
+    device: str = "none"
+    nvme_path: Optional[str] = None
+    buffer_count: int = 4
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+
+
+class ZeroConfig(DeepSpeedConfigModel):
+    """Reference ``runtime/zero/config.py:266`` — same keys.  On TPU, stages
+    are realized as GSPMD sharding specs (see runtime/zero/partition.py)."""
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = 500_000_000
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = 500_000_000
+    overlap_comm: bool = True
+    offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None
+    offload_optimizer: Optional[DeepSpeedZeroOffloadOptimizerConfig] = None
+    sub_group_size: int = 1_000_000_000
+    stage3_max_live_parameters: int = 1_000_000_000
+    stage3_max_reuse_distance: int = 1_000_000_000
+    stage3_prefetch_bucket_size: int = 50_000_000
+    stage3_param_persistence_threshold: int = 100_000
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    zero_hpz_partition_size: int = 1
+    mics_shard_size: int = -1        # MiCS: shard group size (reference mics.py)
+    mics_hierarchical_params_gather: bool = False
+    round_robin_gradients: bool = False
+    ignore_unused_parameters: bool = True
+    param_persistence_threshold: int = 100_000
+
+
+class OptimizerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class SchedulerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """Reference ``runtime/activation_checkpointing/checkpointing.py:789``
+    configure() keys.  On TPU these select a ``jax.checkpoint`` policy."""
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-native: named jax.ad_checkpoint policy
+    policy: str = "nothing_saveable"  # or dots_saveable / dots_with_no_batch_dims_saveable / everything_saveable
+
+
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = Field(default_factory=list)
+
+
+class TensorBoardConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class WandbConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed_tpu"
+
+
+class CSVConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class MonitorConfig(DeepSpeedConfigModel):
+    tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
+    wandb: WandbConfig = Field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+
+    @property
+    def enabled(self):
+        return self.tensorboard.enabled or self.wandb.enabled or self.csv_monitor.enabled
+
+
+class TensorParallelConfig(DeepSpeedConfigModel):
+    """TPU-native: tp mesh-axis size + sharding rules (reference keeps TP in
+    an external mpu for training and AutoTP for inference)."""
+    tp_size: int = 1
+    autotp: bool = True               # infer sharding rules from param names
+
+
+class PipelineConfig(DeepSpeedConfigModel):
+    stages: int = 1
+    micro_batches: Optional[int] = None
+    partition_method: str = "parameters"
+    activation_checkpoint_interval: int = 0
+
+
+class SequenceParallelConfig(DeepSpeedConfigModel):
+    """TPU-native superset: the reference v0.9.3 has no sequence parallelism
+    (SURVEY §2.3) — ring attention over an ``sp`` mesh axis is idiomatic here."""
+    sp_size: int = 1
+    mode: str = "ring"                # ring | allgather
+
+
+class MoEConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    ep_size: int = 1
+    num_experts: int = 1
+    top_k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_residual: bool = False
+
+
+class AIOConfig(DeepSpeedConfigModel):
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+class ElasticityConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = Field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.2
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch: bool = True
+
+
+class CompressionConfig(DeepSpeedConfigModel):
+    weight_quantization: Dict[str, Any] = Field(default_factory=dict)
+    activation_quantization: Dict[str, Any] = Field(default_factory=dict)
+    sparse_pruning: Dict[str, Any] = Field(default_factory=dict)
+    row_pruning: Dict[str, Any] = Field(default_factory=dict)
+    head_pruning: Dict[str, Any] = Field(default_factory=dict)
+    channel_pruning: Dict[str, Any] = Field(default_factory=dict)
+    layer_reduction: Dict[str, Any] = Field(default_factory=dict)
+
+
+class CurriculumLegacyConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = Field(default_factory=dict)
+
+
+class DataEfficiencyConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: Dict[str, Any] = Field(default_factory=dict)
+    data_routing: Dict[str, Any] = Field(default_factory=dict)
+
+
+class AutotuningConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    fast: bool = True
+    results_dir: str = "autotuning_results"
+    exps_dir: str = "autotuning_exps"
+    overwrite: bool = False
+    metric: str = "throughput"
+    start_profile_step: int = 3
+    end_profile_step: int = 5
+    num_tuning_micro_batch_sizes: int = 3
+    tuner_type: str = "gridsearch"
+    tuner_early_stopping: int = 5
+    tuner_num_trials: int = 50
+    max_train_batch_size: Optional[int] = None
+    min_train_batch_size: int = 1
+
+
+# --------------------------------------------------------------------- #
+class DeepSpeedConfig:
+    """Parse + validate the full config dict (reference
+    ``runtime/config.py:674``)."""
+
+    def __init__(self, config: Union[str, dict], mesh_world_size: Optional[int] = None):
+        if isinstance(config, str):
+            if not os.path.exists(config):
+                raise FileNotFoundError(f"DeepSpeed config path does not exist: {config}")
+            with open(config) as f:
+                self._param_dict = json.load(f)
+        elif isinstance(config, dict):
+            self._param_dict = dict(config)
+        else:
+            raise ValueError(f"config must be a dict or path, got {type(config)}")
+
+        pd = self._param_dict
+        self.fp16 = FP16Config(**pd.get(C.FP16, {}))
+        self.bf16 = BF16Config(**pd.get(C.BF16, pd.get("bfloat16", {})))
+        self.zero_config = ZeroConfig(**pd.get(C.ZERO_OPTIMIZATION, {}))
+        self.optimizer = OptimizerConfig(**pd.get(C.OPTIMIZER, {})) if C.OPTIMIZER in pd else None
+        self.scheduler = SchedulerConfig(**pd.get(C.SCHEDULER, {})) if C.SCHEDULER in pd else None
+        self.activation_checkpointing = ActivationCheckpointingConfig(
+            **pd.get(C.ACTIVATION_CHECKPOINTING, {}))
+        self.flops_profiler = FlopsProfilerConfig(**pd.get(C.FLOPS_PROFILER, {}))
+        self.comms_config = CommsLoggerConfig(**pd.get(C.COMMS_LOGGER, {}))
+        self.monitor_config = MonitorConfig(
+            tensorboard=TensorBoardConfig(**pd.get(C.MONITOR_TENSORBOARD, {})),
+            wandb=WandbConfig(**pd.get(C.MONITOR_WANDB, {})),
+            csv_monitor=CSVConfig(**pd.get(C.MONITOR_CSV, {})),
+        )
+        self.tensor_parallel = TensorParallelConfig(**pd.get(C.TENSOR_PARALLEL, {}))
+        self.pipeline = PipelineConfig(**pd.get(C.PIPELINE_PARALLEL, {})) \
+            if isinstance(pd.get(C.PIPELINE_PARALLEL, {}), dict) else PipelineConfig()
+        self.sequence_parallel = SequenceParallelConfig(**pd.get(C.SEQUENCE_PARALLEL, {}))
+        self.moe = MoEConfig(**pd.get("moe", {}))
+        self.aio_config = AIOConfig(**pd.get(C.AIO, {}))
+        self.elasticity = ElasticityConfig(**pd.get(C.ELASTICITY, {}))
+        self.compression_config = CompressionConfig(**pd.get(C.COMPRESSION_TRAINING, {}))
+        self.curriculum_learning_legacy = CurriculumLegacyConfig(
+            **pd.get(C.CURRICULUM_LEARNING_LEGACY, {}))
+        self.data_efficiency = DataEfficiencyConfig(**pd.get(C.DATA_EFFICIENCY, {}))
+        self.autotuning_config = AutotuningConfig(**pd.get(C.AUTOTUNING, {}))
+
+        self.gradient_clipping = pd.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
+        self.prescale_gradients = pd.get(C.PRESCALE_GRADIENTS, False)
+        self.gradient_predivide_factor = pd.get(C.GRADIENT_PREDIVIDE_FACTOR, 1.0)
+        self.sparse_gradients_enabled = pd.get(C.SPARSE_GRADIENTS, False)
+        self.steps_per_print = pd.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.wall_clock_breakdown = pd.get(C.WALL_CLOCK_BREAKDOWN, False)
+        self.dump_state = pd.get(C.DUMP_STATE, False)
+        self.zero_allow_untested_optimizer = pd.get("zero_allow_untested_optimizer", False)
+        self.seed = pd.get("seed", 42)
+        self.gradient_accumulation_dtype = pd.get("data_types", {}).get(
+            "grad_accum_dtype", None)
+        self.communication_data_type = pd.get("communication_data_type", None)
+
+        # Batch triple resolution
+        self.train_batch_size = pd.get(C.TRAIN_BATCH_SIZE)
+        self.train_micro_batch_size_per_gpu = pd.get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+        self.gradient_accumulation_steps = pd.get(C.GRADIENT_ACCUMULATION_STEPS)
+        self._mesh_world_size = mesh_world_size
+        self._configure_train_batch_size()
+
+    # ------------------------------------------------------------------ #
+    @property
+    def zero_enabled(self):
+        return self.zero_config.stage > 0
+
+    @property
+    def zero_optimization_stage(self):
+        return self.zero_config.stage
+
+    def _configure_train_batch_size(self):
+        """Complete/validate the triple against the DP world size
+        (reference ``runtime/config.py`` _set_batch_related_parameters)."""
+        dp_world = self._mesh_world_size or 1
+        tbs, mbs, gas = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
+                         self.gradient_accumulation_steps)
+        if tbs is not None and mbs is not None and gas is not None:
+            if tbs != mbs * gas * dp_world:
+                raise ValueError(
+                    f"train_batch_size ({tbs}) != micro_batch ({mbs}) * "
+                    f"grad_accum ({gas}) * dp_world ({dp_world})")
+        elif tbs is not None and mbs is not None:
+            gas = tbs // (mbs * dp_world)
+            if gas * mbs * dp_world != tbs:
+                raise ValueError(
+                    f"train_batch_size {tbs} not divisible by micro_batch*world "
+                    f"{mbs * dp_world}")
+        elif tbs is not None and gas is not None:
+            mbs = tbs // (gas * dp_world)
+            if mbs * gas * dp_world != tbs:
+                raise ValueError("batch triple inconsistent")
+        elif mbs is not None:
+            gas = gas or 1
+            tbs = mbs * gas * dp_world
+        elif tbs is not None:
+            mbs = tbs // dp_world
+            gas = 1
+            if mbs * dp_world != tbs:
+                raise ValueError(f"train_batch_size {tbs} not divisible by dp world {dp_world}")
+        else:
+            mbs, gas = 1, 1
+            tbs = dp_world
+            logger.warning("no batch config given; defaulting to micro_batch=1, grad_accum=1")
+        self.train_batch_size = tbs
+        self.train_micro_batch_size_per_gpu = mbs
+        self.gradient_accumulation_steps = gas
+
+    def print_config(self, name="DeepSpeedConfig"):
+        logger.info(f"{name}:\n{json.dumps(self._param_dict, indent=2, default=str)}")

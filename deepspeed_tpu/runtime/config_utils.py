@@ -1,0 +1,23 @@
+"""Typed config base (analog of reference ``runtime/config_utils.py``
+``DeepSpeedConfigModel``): pydantic models that tolerate unknown keys,
+support deprecated aliases, and pretty-print."""
+
+import json
+
+from pydantic import BaseModel, ConfigDict
+
+
+class DeepSpeedConfigModel(BaseModel):
+    model_config = ConfigDict(extra="allow", populate_by_name=True,
+                              arbitrary_types_allowed=True)
+
+    def dump(self):
+        return json.dumps(self.model_dump(), indent=2, default=str)
+
+
+def get_scalar_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)

@@ -1,0 +1,90 @@
+"""Data loading — parity with reference ``runtime/dataloader.py``
+(``DeepSpeedDataLoader:41``, ``RepeatingLoader:17``).
+
+On TPU the DistributedSampler disappears: batches are *global* — every JAX
+process feeds its local shard of a globally-sharded batch, and the engine
+places them with the DP/SP data sharding.  This loader handles host-side
+batching/collation from an indexable dataset (numpy arrays, dict-of-arrays,
+torch Datasets, or any sequence)."""
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class RepeatingLoader:
+    """Wrap an iterator to restart on StopIteration (reference ``:17``)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+def default_collate(samples):
+    """Stack a list of samples into a batch pytree."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([np.asarray(s[k]) for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(np.stack([np.asarray(s[i]) for s in samples])
+                           for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class DeepSpeedDataLoader:
+
+    def __init__(self, dataset, batch_size, collate_fn=None, num_workers=0,
+                 engine=None, drop_last=True, shuffle=False, seed=0,
+                 data_sampler=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or default_collate
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.engine = engine
+        self.data_sampler = data_sampler
+        self.epoch = 0
+        self._seed = seed
+        self.len = len(dataset) // batch_size if drop_last else \
+            (len(dataset) + batch_size - 1) // batch_size
+
+    def __len__(self):
+        return self.len
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+        if self.data_sampler is not None and hasattr(self.data_sampler, "set_epoch"):
+            self.data_sampler.set_epoch(epoch)
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.data_sampler is not None:
+            order = list(iter(self.data_sampler))
+        elif self.shuffle:
+            rng = np.random.default_rng(self._seed + self.epoch)
+            order = rng.permutation(n).tolist()
+        else:
+            order = list(range(n))
+        for start in range(0, n - (self.batch_size - 1 if self.drop_last else 0),
+                           self.batch_size):
+            idx = order[start:start + self.batch_size]
+            if not idx:
+                return
+            samples = [self.dataset[i] for i in idx]
+            batch = self.collate_fn(samples)
+            if self.engine is not None:
+                batch = self.engine.put_batch(batch)
+            yield batch

@@ -1,0 +1,91 @@
+"""Loss scaling — parity with reference ``runtime/fp16/loss_scaler.py:66,90``
+(``LossScaler``/``DynamicLossScaler``).
+
+On TPU bf16 needs no scaling (the default); fp16 mode keeps the reference
+semantics: dynamic scale doubles every ``scale_window`` good steps, halves on
+overflow, never below ``min_scale``.  The scaler state lives as traced scalars
+inside the jitted step so overflow handling is branch-free (``lax.cond``)."""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class LossScalerState(NamedTuple):
+    scale: jnp.ndarray          # f32 scalar
+    good_steps: jnp.ndarray     # i32 scalar
+    hysteresis: jnp.ndarray     # i32 scalar
+
+
+class DynamicLossScaler:
+
+    def __init__(self, init_scale=2**16, scale_factor=2.0, scale_window=1000,
+                 min_scale=1.0, delayed_shift=1, consecutive_hysteresis=False,
+                 raise_error_at_min_scale=False):
+        self.init_scale = float(init_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.min_scale = float(min_scale)
+        self.delayed_shift = int(delayed_shift)
+        self.consecutive_hysteresis = consecutive_hysteresis
+
+    def init(self):
+        return LossScalerState(
+            scale=jnp.asarray(self.init_scale, jnp.float32),
+            good_steps=jnp.asarray(0, jnp.int32),
+            hysteresis=jnp.asarray(self.delayed_shift, jnp.int32))
+
+    def update(self, state: LossScalerState, found_inf) -> LossScalerState:
+        """Branch-free dynamic-scale update given the overflow flag.
+
+        Reference semantics (``loss_scaler.py update_scale``): every overflow
+        decrements hysteresis; the scale halves only once hysteresis is
+        exhausted, then hysteresis resets.  With ``consecutive_hysteresis``
+        a good step restores hysteresis; without it, good steps leave it
+        depleted so repeated (even non-consecutive) overflows drop the scale.
+        """
+        found_inf = found_inf.astype(jnp.bool_)
+        hysteresis = jnp.where(found_inf, jnp.maximum(state.hysteresis - 1, 0),
+                               state.hysteresis)
+        drop = found_inf & (hysteresis <= 0)
+        new_scale = jnp.where(
+            drop,
+            jnp.maximum(state.scale / self.scale_factor, self.min_scale),
+            state.scale)
+        window_hit = (state.good_steps + 1) >= self.scale_window
+        grow = (~found_inf) & window_hit
+        new_scale = jnp.where(grow, new_scale * self.scale_factor, new_scale)
+        new_good = jnp.where(found_inf | grow, 0, state.good_steps + 1)
+        restore = drop | ((~found_inf) & jnp.asarray(self.consecutive_hysteresis))
+        new_hyst = jnp.where(restore, jnp.asarray(self.delayed_shift, jnp.int32),
+                             hysteresis)
+        return LossScalerState(new_scale, new_good.astype(jnp.int32), new_hyst)
+
+
+class StaticLossScaler:
+
+    def __init__(self, scale=1.0):
+        self.scale_value = float(scale)
+
+    def init(self):
+        return LossScalerState(
+            scale=jnp.asarray(self.scale_value, jnp.float32),
+            good_steps=jnp.asarray(0, jnp.int32),
+            hysteresis=jnp.asarray(1, jnp.int32))
+
+    def update(self, state, found_inf):
+        return state
+
+
+def create_loss_scaler(fp16_config):
+    """Reference ``fp16/loss_scaler.py CreateLossScaler`` semantics:
+    loss_scale==0 → dynamic, else static."""
+    if not fp16_config.enabled:
+        return StaticLossScaler(1.0)
+    if fp16_config.loss_scale == 0:
+        return DynamicLossScaler(
+            init_scale=2.0 ** fp16_config.initial_scale_power,
+            scale_window=fp16_config.loss_scale_window,
+            min_scale=fp16_config.min_loss_scale,
+            delayed_shift=fp16_config.hysteresis)
+    return StaticLossScaler(fp16_config.loss_scale)

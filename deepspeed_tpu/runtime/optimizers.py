@@ -1,0 +1,153 @@
+"""Optimizer factory + the simpler optimizers.
+
+Analog of reference ``runtime/engine.py:1193 _configure_basic_optimizer``:
+maps the config ``optimizer.type`` string to an optimizer instance.  All
+optimizers share the functional protocol ``init(params)``/
+``update(grads, state, params, lr, step)`` and run fused inside the jitted
+train step.
+"""
+
+from typing import NamedTuple, Any
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam, FusedAdamW
+from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
+from deepspeed_tpu.runtime import constants as C
+
+
+class SGDState(NamedTuple):
+    momentum: Any
+
+
+class SGD:
+
+    def __init__(self, lr=1e-3, momentum=0.0, weight_decay=0.0, nesterov=False):
+        self.lr = lr
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+
+    def init(self, params):
+        if self.momentum == 0.0:
+            return SGDState(momentum=None)
+        return SGDState(momentum=jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params))
+
+    def update(self, grads, state, params, lr=None, step=1):
+        lr = self.lr if lr is None else lr
+        wd, mu = self.weight_decay, self.momentum
+
+        if mu == 0.0:
+            def leaf(p, g):
+                g32 = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+                return (p.astype(jnp.float32) - lr * g32).astype(p.dtype)
+            return jax.tree.map(leaf, params, grads), state
+
+        def leaf(p, g, b):
+            g32 = g.astype(jnp.float32) + wd * p.astype(jnp.float32)
+            b = mu * b + g32
+            d = g32 + mu * b if self.nesterov else b
+            return (p.astype(jnp.float32) - lr * d).astype(p.dtype), b
+
+        out = jax.tree.map(leaf, params, grads, state.momentum)
+        is_t = lambda t: isinstance(t, tuple)
+        return (jax.tree.map(lambda t: t[0], out, is_leaf=is_t),
+                SGDState(jax.tree.map(lambda t: t[1], out, is_leaf=is_t)))
+
+
+class AdagradState(NamedTuple):
+    accum: Any
+
+
+class Adagrad:
+    """TPU analog of reference ``csrc/adagrad/cpu_adagrad.cpp`` (vectorized
+    host Adagrad) — as a fused device update."""
+
+    def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0, initial_accumulator_value=0.0):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.init_acc = initial_accumulator_value
+
+    def init(self, params):
+        return AdagradState(accum=jax.tree.map(
+            lambda p: jnp.full(p.shape, self.init_acc, jnp.float32), params))
+
+    def update(self, grads, state, params, lr=None, step=1):
+        lr = self.lr if lr is None else lr
+
+        def leaf(p, g, acc):
+            g32 = g.astype(jnp.float32) + self.weight_decay * p.astype(jnp.float32)
+            acc = acc + g32 * g32
+            return (p.astype(jnp.float32) - lr * g32 / (jnp.sqrt(acc) + self.eps)).astype(p.dtype), acc
+
+        out = jax.tree.map(leaf, params, grads, state.accum)
+        is_t = lambda t: isinstance(t, tuple)
+        return (jax.tree.map(lambda t: t[0], out, is_leaf=is_t),
+                AdagradState(jax.tree.map(lambda t: t[1], out, is_leaf=is_t)))
+
+
+class LionState(NamedTuple):
+    momentum: Any
+
+
+class Lion:
+
+    def __init__(self, lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.weight_decay = weight_decay
+
+    def init(self, params):
+        return LionState(momentum=jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params))
+
+    def update(self, grads, state, params, lr=None, step=1):
+        lr = self.lr if lr is None else lr
+        b1, b2, wd = self.beta1, self.beta2, self.weight_decay
+
+        def leaf(p, g, m):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            upd = jnp.sign(b1 * m + (1.0 - b1) * g32) + wd * p32
+            m = b2 * m + (1.0 - b2) * g32
+            return (p32 - lr * upd).astype(p.dtype), m
+
+        out = jax.tree.map(leaf, params, grads, state.momentum)
+        is_t = lambda t: isinstance(t, tuple)
+        return (jax.tree.map(lambda t: t[0], out, is_leaf=is_t),
+                LionState(jax.tree.map(lambda t: t[1], out, is_leaf=is_t)))
+
+
+def build_optimizer(opt_config):
+    """Map config ``optimizer`` block to an instance (reference
+    ``engine.py:1193``)."""
+    if opt_config is None or opt_config.type is None:
+        return FusedAdamW()
+    name = opt_config.type.lower()
+    params = dict(opt_config.params)
+    params.pop("torch_adam", None)
+    params.pop("adam_w_mode", None) if name == C.ADAMW_OPTIMIZER else None
+    if name in (C.ADAM_OPTIMIZER, C.FUSED_ADAM_OPTIMIZER, C.CPU_ADAM_OPTIMIZER):
+        # reference ADAM_W_MODE_DEFAULT=True (engine.py:1205-1208): "Adam"
+        # means decoupled weight decay unless adam_w_mode=false is set.
+        adam_w = params.pop("adam_w_mode", True)
+        return FusedAdam(adam_w_mode=adam_w, **params)
+    if name == C.ADAMW_OPTIMIZER:
+        return FusedAdamW(**params)
+    if name in (C.LAMB_OPTIMIZER, C.ONEBIT_LAMB_OPTIMIZER):
+        params.pop("freeze_step", None)
+        params.pop("comm_backend_name", None)
+        return FusedLamb(**params)
+    if name in (C.ONEBIT_ADAM_OPTIMIZER, C.ZERO_ONE_ADAM_OPTIMIZER):
+        from deepspeed_tpu.ops.adam.onebit_adam import OnebitAdam
+        return OnebitAdam(**params)
+    if name == C.SGD_OPTIMIZER:
+        return SGD(**params)
+    if name == C.ADAGRAD_OPTIMIZER:
+        return Adagrad(**params)
+    if name == C.LION_OPTIMIZER:
+        return Lion(**params)
+    raise ValueError(f"unknown optimizer type: {opt_config.type}")

@@ -1,0 +1,214 @@
+"""ZeRO as GSPMD sharding.
+
+The reference implements ZeRO with ~7k lines of gradient hooks, bucketed
+reduce-scatter, and just-in-time parameter all-gather
+(``runtime/zero/stage_1_and_2.py:90``, ``stage3.py:65``,
+``partition_parameters.py:603``, ``partitioned_param_coordinator.py:43``).
+On TPU the same memory/communication behavior is a *sharding annotation*:
+
+* **ZeRO-1** — optimizer state sharded over the DP axes; XLA all-gathers the
+  updated params once per step (= reference ``stage_1_and_2.py:1750``
+  allgather of updated 16-bit params).
+* **ZeRO-2** — gradients additionally stored sharded; grad production inside
+  the jitted step lowers to reduce-scatter instead of all-reduce
+  (= reference IPG bucketing ``stage_1_and_2.py:833`` — XLA's latency-hiding
+  scheduler provides the comm/compute overlap the comm-stream machinery
+  hand-builds on GPU).
+* **ZeRO-3** — parameters themselves sharded; XLA inserts per-layer
+  all-gathers at use sites and frees gathered buffers after use
+  (= reference trace-based fetch/release coordinator,
+  ``partitioned_param_coordinator.py:230``).
+* **MiCS** — params sharded over the inner (ICI-local) ``edp`` sub-axis only
+  and replicated across the outer axis (= reference two-hop gather,
+  ``runtime/zero/mics.py:24-29``).
+
+This module turns (abstract param tree, topology, zero config, TP rules) into
+``PartitionSpec`` trees for params / grads / optimizer state.
+"""
+
+import re
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import (DP_AXES, EDP_AXIS, EP_AXIS, TP_AXIS)
+from deepspeed_tpu.utils.logging import logger
+
+
+def _used_axes(spec):
+    used = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return used
+
+
+def _axis_group_size(mesh, axes):
+    size = 1
+    for a in axes:
+        size *= mesh.shape[a]
+    return size
+
+
+def choose_zero_dim(shape, spec, mesh, zero_axes):
+    """Pick the dimension to additionally shard over the ZeRO axes: the
+    largest dim divisible by the zero-group size that isn't already sharded.
+    Returns None if nothing fits (leaf stays replicated over DP — the analog
+    of the reference's ``param_persistence_threshold`` persisted params)."""
+    n = _axis_group_size(mesh, zero_axes)
+    if n == 1:
+        return None
+    candidates = []
+    for d, size in enumerate(shape):
+        if spec[d] is None and size % n == 0 and size >= n:
+            candidates.append((size, d))
+    if not candidates:
+        return None
+    return max(candidates)[1]
+
+
+def apply_zero_to_spec(shape, spec, mesh, zero_axes):
+    """Extend a (possibly TP-sharded) spec with ZeRO sharding over ``zero_axes``."""
+    spec = list(spec) + [None] * (len(shape) - len(spec))
+    used = _used_axes(spec)
+    zero_axes = tuple(a for a in zero_axes if a not in used and mesh.shape[a] > 1)
+    if not zero_axes:
+        return P(*spec)
+    d = choose_zero_dim(shape, spec, mesh, zero_axes)
+    if d is None:
+        return P(*spec)
+    spec[d] = zero_axes if len(zero_axes) > 1 else zero_axes[0]
+    return P(*spec)
+
+
+# --------------------------------------------------------------------- #
+# Tensor-parallel sharding rules (AutoTP analog: reference
+# ``module_inject/auto_tp.py:13`` infers row/column slicing from module
+# structure; here we infer from param-tree path names).
+# --------------------------------------------------------------------- #
+# (regex over joined path, partition spec entries by dim-from-the-right)
+# "col" = shard output features (last dim of a kernel), "row" = shard input
+# features (first dim of a 2D kernel) — Megatron column/row linear.
+DEFAULT_TP_RULES = [
+    (r"(q_proj|k_proj|v_proj|qkv|query|key|value|gate_proj|up_proj|wi|fc1|fc_in|c_fc|dense_h_to_4h).*(kernel|weight)$", "col"),
+    (r"(o_proj|out_proj|down_proj|wo|fc2|fc_out|c_proj|dense_4h_to_h|attention_output|dense$).*", "row"),
+    (r"(embed|wte|word_embeddings|embed_tokens).*(embedding|kernel|weight)$", "vocab"),
+    (r"(lm_head|output_projection).*(kernel|weight)$", "col"),
+    (r".*(norm|ln_|layernorm|layer_norm|bias|scale).*", "replicate"),
+]
+
+
+def path_to_str(path):
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def tp_spec_for(path_str, ndim, mesh, rules=None):
+    """PartitionSpec from TP rules for one leaf."""
+    if mesh.shape.get(TP_AXIS, 1) == 1:
+        return P(*([None] * ndim))
+    rules = rules if rules is not None else DEFAULT_TP_RULES
+    low = path_str.lower()
+    for pattern, kind in rules:
+        if re.search(pattern, low):
+            spec = [None] * ndim
+            if kind == "col" and ndim >= 1:
+                spec[-1] = TP_AXIS
+            elif kind == "row" and ndim >= 2:
+                spec[-2] = TP_AXIS
+            elif kind == "vocab" and ndim >= 2:
+                spec[0] = TP_AXIS
+            # "replicate" leaves all None
+            return P(*spec)
+    return P(*([None] * ndim))
+
+
+# --------------------------------------------------------------------- #
+class ZeroShardingPlan:
+    """Per-tree PartitionSpec plans for the three state classes."""
+
+    def __init__(self, param_specs, grad_specs, opt_specs, mesh):
+        self.param_specs = param_specs
+        self.grad_specs = grad_specs
+        self.opt_specs = opt_specs
+        self.mesh = mesh
+
+    def shardings(self, specs):
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    @property
+    def param_shardings(self):
+        return self.shardings(self.param_specs)
+
+    @property
+    def grad_shardings(self):
+        return self.shardings(self.grad_specs)
+
+    def opt_shardings_for(self, opt_state):
+        """Match opt-state leaves (moments mirror param shapes) to opt specs."""
+        flat_specs = {path_to_str(p): s for p, s in
+                      jax.tree_util.tree_leaves_with_path(
+                          self.opt_specs, is_leaf=lambda x: isinstance(x, P))}
+        # opt state is a NamedTuple of param-shaped trees; map by suffix path
+        def leaf_spec(path, leaf):
+            ps = path_to_str(path)
+            for k, s in flat_specs.items():
+                if ps.endswith(k) or k.endswith(ps):
+                    return NamedSharding(self.mesh, s)
+            # scalars (loss scale, step counters) replicate
+            if np.ndim(leaf) == 0 or not hasattr(leaf, "shape") or leaf.shape == ():
+                return NamedSharding(self.mesh, P())
+            return NamedSharding(self.mesh, P())
+        return jax.tree_util.tree_map_with_path(leaf_spec, opt_state)
+
+
+def build_sharding_plan(abstract_params, topo, zero_config, tp_rules=None):
+    """The ZeRO "partitioner": params → spec trees for params/grads/opt state.
+
+    ``abstract_params``: pytree of ShapeDtypeStruct (or arrays).
+    """
+    mesh = topo.mesh
+    stage = zero_config.stage if zero_config else 0
+    mics = zero_config.mics_shard_size if zero_config else -1
+    # MiCS: restrict ZeRO sharding to the inner edp sub-axis (ICI-local)
+    # and replicate across ep/outer — reference mics.py two-level gather.
+    if mics and mics > 0:
+        zero_axes = (EDP_AXIS,)
+    else:
+        zero_axes = DP_AXES
+
+    def specs_for(path, leaf, shard_over_zero):
+        shape = leaf.shape
+        ps = path_to_str(path)
+        spec = tp_spec_for(ps, len(shape), mesh, tp_rules)
+        if shard_over_zero:
+            spec = apply_zero_to_spec(shape, spec, mesh, zero_axes)
+        return spec
+
+    param_specs = jax.tree_util.tree_map_with_path(
+        lambda p, l: specs_for(p, l, stage >= 3), abstract_params)
+    grad_specs = jax.tree_util.tree_map_with_path(
+        lambda p, l: specs_for(p, l, stage >= 2), abstract_params)
+    opt_specs = jax.tree_util.tree_map_with_path(
+        lambda p, l: specs_for(p, l, stage >= 1), abstract_params)
+
+    n_leaves = len(jax.tree.leaves(param_specs, is_leaf=lambda x: isinstance(x, P)))
+    logger.info(f"ZeRO stage {stage}: sharding plan over mesh {dict(mesh.shape)} "
+                f"for {n_leaves} param tensors (zero axes={zero_axes})")
+    return ZeroShardingPlan(param_specs, grad_specs, opt_specs, mesh)

@@ -1,0 +1,50 @@
+"""Test harness: simulate an 8-device TPU mesh on CPU.
+
+The analog of the reference's distributed-without-a-cluster mechanism
+(``tests/unit/common.py:89`` DistributedExec): instead of forking processes
+per rank, JAX gives us N virtual devices in one process via
+``--xla_force_host_platform_device_count`` — every sharding/collective code
+path (GSPMD ZeRO, pipeline ppermute, MoE all_to_all) executes for real on the
+CPU mesh.
+"""
+
+import os
+import sys
+
+# Must be set before jax *initializes a backend*.  The environment may import
+# jax at interpreter start (sitecustomize) with JAX_PLATFORMS pinned to the
+# real TPU platform, so overriding the env var alone is not enough — update
+# the live jax config too.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.device_count() == 8, f"expected 8 virtual CPU devices, got {jax.devices()}"
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_topology():
+    """Each test gets a fresh global topology (the analog of tearing down
+    process groups between DistributedTest cases)."""
+    from deepspeed_tpu.parallel import topology
+    topology.reset_topology()
+    yield
+    topology.reset_topology()
+
+
+@pytest.fixture
+def eight_devices():
+    import jax
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs

@@ -1,0 +1,48 @@
+"""Test model zoo — analog of reference ``tests/unit/simple_model.py``
+(SimpleModel / SimpleMoEModel / linear stacks) in flax."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+class SimpleModel(nn.Module):
+    """Linear stack returning cross-entropy-ish loss (reference SimpleModel)."""
+    hidden_dim: int = 16
+    nlayers: int = 2
+
+    @nn.compact
+    def __call__(self, batch):
+        x, y = batch["x"], batch["y"]
+        for i in range(self.nlayers):
+            x = nn.Dense(self.hidden_dim, name=f"linear_{i}")(x)
+            x = nn.relu(x)
+        logits = nn.Dense(self.hidden_dim, name="head")(x)
+        one_hot = jax.nn.one_hot(y, self.hidden_dim)
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * one_hot, axis=-1))
+
+
+class SimpleMLPRegressor(nn.Module):
+    hidden_dim: int = 16
+
+    @nn.compact
+    def __call__(self, batch):
+        x, y = batch["x"], batch["y"]
+        h = nn.Dense(self.hidden_dim)(x)
+        h = nn.tanh(h)
+        out = nn.Dense(x.shape[-1])(h)
+        return jnp.mean((out - y) ** 2)
+
+
+def random_dataset(n=64, dim=16, classes=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"x": rng.standard_normal(dim).astype(np.float32),
+             "y": np.int32(rng.integers(0, classes))} for _ in range(n)]
+
+
+def random_batch(batch_size=8, dim=16, classes=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"x": rng.standard_normal((batch_size, dim)).astype(np.float32),
+            "y": rng.integers(0, classes, size=(batch_size,)).astype(np.int32)}

@@ -1,0 +1,58 @@
+"""Topology tests — analog of reference ``tests/unit/runtime/pipe/test_topology.py``."""
+
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import (
+    ParallelTopology, initialize_topology, get_topology, AXIS_ORDER, DP_AXES)
+
+
+def test_default_topology_all_dp():
+    topo = initialize_topology()
+    assert topo.world_size == 8
+    assert topo.dp == 8
+    assert topo.tp == topo.pp == topo.sp == topo.ep == 1
+    assert topo.mesh.axis_names == AXIS_ORDER
+
+
+def test_2d_topology():
+    topo = initialize_topology(tp=2)
+    assert topo.dp == 4
+    assert topo.get_model_parallel_world_size() == 2
+    assert topo.get_data_parallel_world_size() == 4
+
+
+def test_3d_topology():
+    topo = initialize_topology(tp=2, pp=2)
+    assert topo.dp == 2
+    assert topo.world_size == 8
+
+
+def test_expert_topology():
+    topo = initialize_topology(ep=4)
+    assert topo.dp == 8
+    assert topo.edp == 2
+    assert topo.axis_size("ep") == 4
+
+
+def test_sequence_topology():
+    topo = initialize_topology(sp=2, tp=2)
+    assert topo.sp == 2
+    assert topo.dp == 2
+
+
+def test_invalid_topology_raises():
+    with pytest.raises(ValueError):
+        ParallelTopology(dp=16, tp=2, devices=jax.devices())
+
+
+def test_ep_must_divide_dp():
+    with pytest.raises(ValueError):
+        ParallelTopology(dp=4, ep=3, devices=jax.devices())
+
+
+def test_batch_spec():
+    topo = initialize_topology()
+    assert topo.data_spec() == P(DP_AXES)

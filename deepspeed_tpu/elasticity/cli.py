@@ -1,0 +1,27 @@
+"""ds_elastic CLI (reference bin/ds_elastic): inspect elastic configs."""
+
+import argparse
+import json
+
+from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+
+
+def main():
+    parser = argparse.ArgumentParser(description="DeepSpeed elasticity config inspector")
+    parser.add_argument("-c", "--config", required=True, help="DeepSpeed config json")
+    parser.add_argument("-w", "--world-size", type=int, default=0)
+    args = parser.parse_args()
+    with open(args.config) as f:
+        ds_config = json.load(f)
+    if args.world_size:
+        batch, valid, mb = compute_elastic_config(ds_config, world_size=args.world_size,
+                                                  return_microbatch=True)
+        print(f"world size: {args.world_size} -> global batch: {batch}, micro batch: {mb}")
+    else:
+        batch, valid = compute_elastic_config(ds_config)
+        print(f"global batch: {batch}")
+        print(f"valid world sizes: {valid}")
+
+
+if __name__ == "__main__":
+    main()

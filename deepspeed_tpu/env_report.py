@@ -1,0 +1,46 @@
+"""Environment / op-compatibility report — parity with reference
+``deepspeed/env_report.py`` + ``bin/ds_report``."""
+
+import sys
+
+
+def main():
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.ops.op_builder import op_report
+    from deepspeed_tpu.accelerator import get_accelerator
+
+    accel = get_accelerator()
+    lines = [
+        "-" * 72,
+        "DeepSpeed-TPU C++/Pallas op report",
+        "-" * 72,
+        op_report(),
+        "-" * 72,
+        "General environment:",
+        f"deepspeed_tpu version ... {deepspeed_tpu.__version__}",
+        f"jax version ............. {jax.__version__}",
+        f"default backend ......... {jax.default_backend()}",
+        f"accelerator ............. {accel.device_name()}",
+        f"local devices ........... {accel.device_count()}",
+        f"global devices .......... {accel.global_device_count()}",
+        f"bf16 supported .......... {accel.is_bf16_supported()}",
+        f"python .................. {sys.version.split()[0]}",
+    ]
+    try:
+        import flax
+        import optax
+        lines.append(f"flax / optax ............ {flax.__version__} / {optax.__version__}")
+    except ImportError:
+        pass
+    report = "\n".join(lines)
+    print(report)
+    return report
+
+
+def cli_main():
+    main()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Inference config — parity with reference ``inference/config.py``
+(``DeepSpeedInferenceConfig``).  Same key names; CUDA-graph knobs map to
+"always jitted" (every decode step is a compiled XLA program, which is what
+CUDA graphs approximate on GPU)."""
+
+from typing import Any, Dict, Optional
+
+from pydantic import Field
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    tp_size: int = 1
+    mpu: Any = None
+    tp_group: Any = None
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    bits: int = 8
+    group_size: int = 64
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    kernel_inject: bool = Field(True, alias="replace_with_kernel_inject")
+    dtype: str = "bfloat16"
+    tensor_parallel: DeepSpeedTPConfig = Field(
+        default_factory=DeepSpeedTPConfig, alias="tp")
+    mp_size: Optional[int] = None          # legacy alias for tp_size
+    max_out_tokens: int = Field(1024, alias="max_tokens")
+    min_out_tokens: int = 1
+    max_batch_size: int = 8
+    replace_method: str = "auto"
+    enable_cuda_graph: bool = True         # = jitted decode step (always on)
+    checkpoint: Optional[Any] = None
+    base_dir: str = ""
+    quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    moe: Dict[str, Any] = Field(default_factory=dict)
+    ep_size: int = 1
+    injection_policy: Optional[Dict] = None
+    return_tuple: bool = True
+    triangular_masking: bool = True
+
+    def model_post_init(self, _ctx):
+        if self.mp_size is not None and self.tensor_parallel.tp_size == 1:
+            self.tensor_parallel.tp_size = self.mp_size

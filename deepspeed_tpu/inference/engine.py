@@ -1,0 +1,211 @@
+"""InferenceEngine — sharded, jitted generation.
+
+TPU-native re-design of reference ``inference/engine.py:89``
+(``InferenceEngine``): the reference swaps model layers for fused CUDA
+kernels (``_apply_injection_policy :408``), slices weights for TP
+(``module_inject/replace_module.py:31``), manages a KV-cache workspace
+(``inference_context.h``), and captures CUDA graphs (``:526``).  Here:
+
+* "kernel injection" is compilation: the whole decode step is one jitted XLA
+  program (fused by construction), with Pallas flash attention for prefill
+  where supported — there is no separate injected-module zoo to maintain;
+* TP weight slicing is a sharding plan (AutoTP name rules,
+  ``runtime/zero/partition.py``) applied as param ``NamedSharding``s — XLA
+  inserts the per-layer collectives the reference codes by hand;
+* the KV cache is a donated, statically-shaped [L, B, S_max, KVH, D] buffer
+  updated in-place via donation (the workspace allocator equivalent);
+* CUDA-graph capture/replay == jit compile/execute — every step after the
+  first runs from the executable cache.
+
+``generate`` implements greedy + temperature/top-k/top-p sampling with a
+``lax.scan`` decode loop (one compiled program for the whole generation).
+"""
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+from deepspeed_tpu.parallel import topology as topo_mod
+from deepspeed_tpu.runtime.zero.partition import build_sharding_plan
+from deepspeed_tpu.runtime.config import ZeroConfig
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class InferenceEngine:
+
+    def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None,
+                 params=None):
+        self.module = model
+        self._config = config or DeepSpeedInferenceConfig()
+        tp = self._config.tensor_parallel.tp_size
+        self.topology = topo_mod.initialize_topology(tp=tp, ep=self._config.ep_size)
+        self.mesh = self.topology.mesh
+        self.compute_dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
+                              "float32": jnp.float32, "fp16": jnp.float16,
+                              "bf16": jnp.bfloat16, "fp32": jnp.float32,
+                              "float": jnp.float32, "half": jnp.float16}[
+                                  str(self._config.dtype).replace("torch.", "")]
+        self._params = None
+        self._compiled = {}
+        self._rng = jax.random.key(0)
+        if params is not None:
+            self.set_params(params)
+        elif self._config.checkpoint is not None:
+            self.load_checkpoint(self._config.checkpoint)
+
+    # ------------------------------------------------------------------ #
+    # Weights: the "injection"/TP-slicing step (reference engine.py:408)
+    # ------------------------------------------------------------------ #
+    def _plan_for(self, abstract):
+        # inference: params sharded over tp only (no ZeRO axes), replicated
+        # over dp — the AutoTP analog
+        return build_sharding_plan(abstract, self.topology, ZeroConfig(stage=0))
+
+    def set_params(self, params):
+        abstract = jax.eval_shape(lambda t: t, params)
+        self._plan = self._plan_for(abstract)
+        cast = self.compute_dtype
+        put = jax.jit(lambda t: jax.tree.map(
+            lambda p: p.astype(cast)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, t),
+            out_shardings=self._plan.param_shardings)
+        self._params = put(params)
+        n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(self._params))
+        log_dist(f"inference params placed: {n/1e6:.1f}M, tp={self.topology.tp}, "
+                 f"dtype={cast.__name__}", ranks=[0])
+
+    def init_params(self, example_ids=None, seed=0):
+        """Random init (testing / benchmarking without a checkpoint)."""
+        if example_ids is None:
+            example_ids = jnp.zeros((1, 8), jnp.int32)
+        params = self.module.init(jax.random.key(seed), {"input_ids": example_ids})
+        self.set_params(params)
+
+    def load_checkpoint(self, path, tag=None):
+        import os, pickle
+        if os.path.isfile(path):
+            with open(path, "rb") as f:
+                self.set_params(pickle.load(f))
+            return
+        from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import \
+            OrbaxCheckpointEngine
+        eng = OrbaxCheckpointEngine()
+        if tag is None and os.path.exists(os.path.join(path, "latest")):
+            with open(os.path.join(path, "latest")) as f:
+                tag = f.read().strip()
+        state_path = os.path.join(path, str(tag), "state") if tag else path
+        arrays, _ = eng.load(state_path)
+        self.set_params(arrays["module"] if isinstance(arrays, dict)
+                        and "module" in arrays else arrays)
+
+    @property
+    def params(self):
+        return self._params
+
+    # ------------------------------------------------------------------ #
+    # Forward / generation
+    # ------------------------------------------------------------------ #
+    def forward(self, input_ids, attention_mask=None, **kwargs):
+        """Full logits (reference engine.forward :586); ``attention_mask``
+        masks padded positions."""
+        assert self._params is not None, "no parameters: set_params/init_params first"
+        if kwargs:
+            raise TypeError(f"unsupported forward arguments: {sorted(kwargs)}")
+        key = "fwd" if attention_mask is None else "fwd_masked"
+        if key not in self._compiled:
+            if attention_mask is None:
+                self._compiled[key] = jax.jit(
+                    lambda p, ids: self.module.apply(
+                        p, ids, method=type(self.module).logits))
+            else:
+                self._compiled[key] = jax.jit(
+                    lambda p, ids, m: self.module.apply(
+                        p, ids, m, method=type(self.module).logits))
+        args = (self._params, jnp.asarray(input_ids))
+        if attention_mask is not None:
+            args += (jnp.asarray(attention_mask),)
+        return self._compiled[key](*args)
+
+    __call__ = forward
+
+    def _get_generate(self, prompt_len, max_new_tokens, do_sample, temperature,
+                      top_k, top_p):
+        key = ("gen", prompt_len, max_new_tokens, do_sample, temperature, top_k, top_p)
+        if key in self._compiled:
+            return self._compiled[key]
+        module = self.module
+        max_len = prompt_len + max_new_tokens
+
+        def sample_fn(logits, rng):
+            logits = logits.astype(jnp.float32)
+            if not do_sample:
+                return jnp.argmax(logits, axis=-1)
+            if temperature != 1.0:
+                logits = logits / jnp.maximum(temperature, 1e-6)
+            if top_k > 0:
+                kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+                logits = jnp.where(logits < kth, -1e30, logits)
+            if 0.0 < top_p < 1.0:
+                sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+                probs = jax.nn.softmax(sorted_logits, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+                cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+                logits = jnp.where(logits < cutoff, -1e30, logits)
+            return jax.random.categorical(rng, logits, axis=-1)
+
+        def generate(params, input_ids, rng, eos_id):
+            B = input_ids.shape[0]
+            cache = module.init_cache(B, max_len, dtype=self.compute_dtype)
+            # prefill the prompt in one pass
+            logits, cache = module.apply(params, input_ids, cache, 0,
+                                         method=type(module).decode)
+            rng, sub = jax.random.split(rng)
+            next_tok = sample_fn(logits[:, -1], sub)
+
+            def step(carry, _):
+                tok, cache, pos, rng, done = carry
+                logits, cache = module.apply(params, tok[:, None], cache, pos,
+                                             method=type(module).decode)
+                rng, sub = jax.random.split(rng)
+                nxt = sample_fn(logits[:, -1], sub)
+                nxt = jnp.where(done, eos_id, nxt)
+                done = done | (nxt == eos_id)
+                return (nxt, cache, pos + 1, rng, done), nxt
+
+            done0 = (next_tok == eos_id)
+            (_, _, _, _, _), toks = jax.lax.scan(
+                step, (next_tok, cache, jnp.asarray(prompt_len), rng, done0),
+                None, length=max_new_tokens - 1)
+            return jnp.concatenate([next_tok[:, None], toks.T], axis=1)
+
+        self._compiled[key] = jax.jit(generate)
+        return self._compiled[key]
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 temperature=1.0, top_k=0, top_p=1.0, eos_token_id=-1, seed=None,
+                 attention_mask=None):
+        """Autoregressive generation: returns [B, max_new_tokens] new tokens
+        (reference ``engine._generate :614``; HF-style args).
+
+        Prompts must be unpadded (equal length per batch row) — the cached
+        decode path has no padding mask yet.
+        """
+        if attention_mask is not None:
+            raise NotImplementedError(
+                "generate() requires unpadded prompts; attention_mask is not "
+                "supported in the cached decode path yet")
+        assert self._params is not None, "no parameters: set_params/init_params first"
+        input_ids = jnp.asarray(input_ids)
+        if seed is not None:
+            self._rng = jax.random.key(seed)
+        self._rng, rng = jax.random.split(self._rng)
+        fn = self._get_generate(input_ids.shape[1], int(max_new_tokens),
+                                bool(do_sample), float(temperature), int(top_k),
+                                float(top_p))
+        return fn(self._params, input_ids, rng, jnp.asarray(eos_token_id))

@@ -1,0 +1,145 @@
+"""Launcher CLI — parity with reference ``launcher/runner.py:377`` (main),
+``launch.py:216`` (per-node spawn), ``multinode_runner.py`` (PDSH/MPI/SLURM).
+
+TPU launch model differs fundamentally from the GPU one: JAX is
+single-controller-per-host (one Python process drives all local chips), so
+the per-GPU process fan-out (``launch.py``) collapses to one process per
+host.  What remains:
+
+* single host: exec the training script directly (all local chips visible);
+* TPU pods: one process per host, each calling ``jax.distributed.initialize``
+  — coordinator env (DSTPU_COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID)
+  is injected per-host, the analog of RANK/WORLD_SIZE env the reference sets
+  (``launch.py:216``);
+* multi-node over ssh: hostfile-driven remote spawn (the PDSH runner analog,
+  ``multinode_runner.py:51``).
+"""
+
+import argparse
+import base64
+import json
+import os
+import shlex
+import subprocess
+import sys
+
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu launcher",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="Hostfile: lines of '<host> slots=<n>'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="Include filter, e.g. 'host1@host2'")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="Exclude filter")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_gpus", "--num_chips", type=int, default=-1,
+                        dest="num_gpus")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--launcher", type=str, default="ssh",
+                        choices=["ssh", "pdsh", "local"])
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--elastic_training", action="store_true")
+    parser.add_argument("--min_elastic_nodes", type=int, default=-1)
+    parser.add_argument("--max_elastic_nodes", type=int, default=-1)
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path):
+    """Parse '<host> slots=<n>' lines (reference ``runner.py:189``)."""
+    if not os.path.isfile(hostfile_path):
+        return {}
+    resource_pool = {}
+    with open(hostfile_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=")[1])
+            if host in resource_pool:
+                raise ValueError(f"host {host} repeated in hostfile")
+            resource_pool[host] = slots
+    return resource_pool
+
+
+def _filter_hosts(resource_pool, include_str, exclude_str):
+    """--include/--exclude host filters (reference ``runner.py:244``)."""
+    hosts = dict(resource_pool)
+    if include_str:
+        keep = set(include_str.split("@"))
+        hosts = {h: s for h, s in hosts.items() if h in keep}
+    if exclude_str:
+        drop = set(exclude_str.split("@"))
+        hosts = {h: s for h, s in hosts.items() if h not in drop}
+    return hosts
+
+
+def encode_world_info(resource_pool):
+    """b64 world info (reference ``runner.py:342``)."""
+    data = json.dumps(resource_pool).encode()
+    return base64.urlsafe_b64encode(data).decode()
+
+
+def decode_world_info(encoded):
+    return json.loads(base64.urlsafe_b64decode(encoded.encode()).decode())
+
+
+def main(args=None):
+    args = parse_args(args)
+    resource_pool = fetch_hostfile(args.hostfile)
+    resource_pool = _filter_hosts(resource_pool, args.include, args.exclude)
+    if args.num_nodes > 0:
+        resource_pool = dict(list(resource_pool.items())[:args.num_nodes])
+
+    cmd_tail = [args.user_script] + args.user_args
+
+    if not resource_pool or args.launcher == "local":
+        # single host: one controller process sees all local chips
+        logger.info(f"launching locally: {' '.join(cmd_tail)}")
+        env = dict(os.environ)
+        result = subprocess.run([sys.executable] + cmd_tail, env=env)
+        sys.exit(result.returncode)
+
+    hosts = list(resource_pool)
+    master = args.master_addr or hosts[0]
+    world = len(hosts)
+    procs = []
+    logger.info(f"launching on {world} hosts via {args.launcher}: {hosts}")
+    for pid, host in enumerate(hosts):
+        env_exports = {
+            "DSTPU_COORDINATOR_ADDRESS": f"{master}:{args.master_port}",
+            "DSTPU_NUM_PROCESSES": str(world),
+            "DSTPU_PROCESS_ID": str(pid),
+        }
+        export_str = " ".join(f"{k}={v}" for k, v in env_exports.items())
+        remote_cmd = f"cd {shlex.quote(os.getcwd())} && {export_str} " \
+                     f"{sys.executable} {' '.join(shlex.quote(c) for c in cmd_tail)}"
+        if host in ("localhost", "127.0.0.1"):
+            p = subprocess.Popen(["bash", "-c", remote_cmd])
+        else:
+            p = subprocess.Popen(["ssh", "-o", "StrictHostKeyChecking=no",
+                                  host, remote_cmd])
+        procs.append(p)
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Pipelined transformer — the ``GPT2ModelPipe`` pattern for this framework:
+builds a ``PipelineModule`` from a ``TransformerConfig`` with single-tensor
+layers (embed → blocks → norm+head) so the pipeline engine can split
+pre/body/post and stack the uniform trunk."""
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.models.transformer import (TransformerConfig, Attention, MLP,
+                                              _norm, cross_entropy_loss)
+from deepspeed_tpu.runtime.pipe.module import PipelineModule, LayerSpec
+
+
+class EmbedPipe(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, param_dtype=jnp.float32,
+                     name="embed_tokens")(input_ids)
+        if cfg.position_embedding == "learned":
+            B, S = input_ids.shape
+            pos = jnp.broadcast_to(jnp.arange(S), (B, S))
+            x = x + nn.Embed(cfg.max_seq_len, cfg.hidden_size,
+                             param_dtype=jnp.float32,
+                             name="embed_positions")(pos)
+        return x.astype(cfg.jnp_dtype)
+
+
+class BlockPipe(nn.Module):
+    """Single-tensor transformer block: positions recomputed from shape
+    (the pipeline passes activations only, reference ``pipe/module.py``
+    layers are single-tensor too)."""
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        B, S, _ = x.shape
+        positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+        attn, _ = Attention(cfg, name="attn")(
+            _norm(cfg, "input_norm")(x).astype(cfg.jnp_dtype), positions, None)
+        x = x + attn
+        x = x + MLP(cfg, name="mlp")(
+            _norm(cfg, "post_attn_norm")(x).astype(cfg.jnp_dtype))
+        return x
+
+
+class HeadPipe(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        x = _norm(cfg, "final_norm")(x).astype(cfg.jnp_dtype)
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.jnp_dtype,
+                        param_dtype=jnp.float32, name="lm_head")(x)
+
+
+def lm_loss(logits, labels):
+    return cross_entropy_loss(logits, labels)
+
+
+def transformer_pipe(config: TransformerConfig, num_stages=None,
+                     **pipe_kwargs) -> PipelineModule:
+    layers = [LayerSpec(EmbedPipe, config)]
+    layers += [LayerSpec(BlockPipe, config) for _ in range(config.num_layers)]
+    layers += [LayerSpec(HeadPipe, config)]
+    return PipelineModule(layers, num_stages=num_stages, loss_fn=lm_loss,
+                          **pipe_kwargs)

@@ -1,0 +1,92 @@
+"""MoE layer facade — parity with reference ``deepspeed/moe/layer.py:16``
+(``MoE``) and ``moe/experts.py:10`` (``Experts``), as a flax module.
+
+Expert parameters carry a leading expert dim E; the sharding plan places it
+on the ``ep`` mesh axis (see ``EXPERT_PARAM_PATTERN`` in
+``runtime/zero/partition.py``), so the dispatch/combine einsums in
+``sharded_moe.py`` lower to all-to-alls over ICI and expert-parameter
+gradients reduce only over the expert-data-parallel group — the semantics
+``utils/groups.py:108`` builds with explicit process groups.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.moe.sharded_moe import TopKGate, moe_dispatch_combine
+
+
+class ExpertsMLP(nn.Module):
+    """Default expert: the standard 2-layer MLP, vectorized over experts
+    (reference wraps arbitrary expert modules; ``Experts`` replicates them —
+    here one einsum-batched module computes all local experts on the MXU)."""
+    num_experts: int
+    hidden_size: int
+    ffn_hidden_size: int
+    activation: Callable = nn.gelu
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        # x: [E, C, M]
+        E, M, F = self.num_experts, self.hidden_size, self.ffn_hidden_size
+        wi = self.param("experts_wi", nn.initializers.lecun_normal(),
+                        (E, M, F), jnp.float32)
+        wo = self.param("experts_wo", nn.initializers.lecun_normal(),
+                        (E, F, M), jnp.float32)
+        h = jnp.einsum("ecm,emf->ecf", x, wi.astype(x.dtype))
+        h = self.activation(h)
+        return jnp.einsum("ecf,efm->ecm", h, wo.astype(x.dtype))
+
+
+class MoE(nn.Module):
+    """Mixture-of-experts block (reference ``layer.py:16``).
+
+    ``__call__(x)`` with x [..., M] returns (y, aux_loss, exp_counts) —
+    the reference's output triple.
+    """
+    hidden_size: int
+    num_experts: int = 1
+    ep_size: int = 1
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_residual: bool = False
+    ffn_hidden_size: Optional[int] = None
+    expert: Optional[nn.Module] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        M = self.hidden_size
+        orig_shape = x.shape
+        tokens = x.reshape(-1, M)
+
+        gate_w = self.param("gate_kernel", nn.initializers.lecun_normal(),
+                            (M, self.num_experts), jnp.float32)
+        logits = tokens.astype(jnp.float32) @ gate_w
+        gate = TopKGate(M, self.num_experts, self.k, self.capacity_factor,
+                        self.eval_capacity_factor, self.min_capacity,
+                        self.noisy_gate_policy, self.drop_tokens)
+        rng = self.make_rng("gating") if (train and self.noisy_gate_policy
+                                          and self.has_rng("gating")) else None
+        aux_loss, combine, dispatch, exp_counts = gate(logits, train, rng)
+
+        experts = self.expert or ExpertsMLP(
+            self.num_experts, M, self.ffn_hidden_size or 4 * M, dtype=self.dtype)
+        y = moe_dispatch_combine(tokens, combine, dispatch, experts)
+
+        if self.use_residual:
+            # residual MoE (reference layer.py use_residual): blend with a
+            # dense MLP through a learned coefficient
+            mlp_out = nn.Dense(M, dtype=x.dtype, name="residual_mlp")(tokens)
+            coef = nn.Dense(2, dtype=x.dtype, name="coefficient")(tokens)
+            coef = jax.nn.softmax(coef, axis=-1)
+            y = y * coef[..., 0:1] + mlp_out * coef[..., 1:2]
+
+        return y.reshape(orig_shape).astype(x.dtype), aux_loss, exp_counts

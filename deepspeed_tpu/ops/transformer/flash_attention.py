@@ -1,0 +1,379 @@
+"""Pallas flash attention (fwd + bwd) — the centerpiece training kernel.
+
+TPU-native equivalent of the reference's fused transformer attention kernels
+(``csrc/transformer/*.cu`` softmax/dropout/gemm stack behind
+``DeepSpeedTransformerLayer``, and the inference ``softmax_context`` op,
+``csrc/transformer/inference/csrc/pt_binding.cpp:1934-``).  Instead of
+separate gemm+softmax kernels stitched by a C++ scheduler, this is one
+online-softmax kernel: O(S) memory, no S×S materialization, MXU-tiled.
+
+Layout: inputs [B, S, H, D] (model-native); kernel operates in [B, H, S, D].
+GQA is handled in the BlockSpec index maps (kv head = h * KVH // H) — no
+jnp.repeat materialization.
+
+Causal masking skips fully-masked KV blocks via ``pl.when`` predication.
+The backward pass uses the saved LSE (log-sum-exp) rows, with two kernels:
+one accumulating dq over kv blocks, one accumulating (dk, dv) over q blocks —
+the standard flash-attention-2 decomposition.
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _interpret():
+    return jax.default_backend() == "cpu"
+
+
+def pallas_supported():
+    """True when Pallas kernels can run here.
+
+    CPU runs the interpreter; native TPU compiles Mosaic.  Tunneled/relay
+    platforms (e.g. 'axon') hang in remote kernel compilation — route those
+    to the XLA fallback unless DSTPU_FORCE_FLASH=1.
+    """
+    import os
+    if os.environ.get("DSTPU_FORCE_FLASH") == "1":
+        return True
+    if os.environ.get("DSTPU_DISABLE_FLASH") == "1":
+        return False
+    return jax.default_backend() in ("cpu", "tpu")
+
+
+# --------------------------------------------------------------------- #
+# Forward
+# --------------------------------------------------------------------- #
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, block_q, block_k, causal, nk, kv_len):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # skip kv blocks strictly above the causal diagonal
+    run = (not causal) or (ik * block_k <= iq * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)          # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)          # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)          # [bk, d]
+        # zero padded tail rows: OOB block reads are undefined, and
+        # garbage * 0-probability still poisons the matmul with NaN
+        kv_rows = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                          (block_k, 1), 0)
+        valid_kv = kv_rows < kv_len
+        k = jnp.where(valid_kv, k, 0.0)
+        v = jnp.where(valid_kv, v, 0.0)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        cols = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                       (block_q, block_k), 1)
+        mask = cols < kv_len           # tail-block padding
+        if causal:
+            rows = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                           (block_q, block_k), 0)
+            mask = mask & (rows >= cols)
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_scr[:, 0:1]                        # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                        # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)                # [bq, 1]
+        l_new = l_scr[:, 0:1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = l_scr[:, 0:1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scr[:, 0] + jnp.log(safe_l[:, 0]))
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k):
+    B, H, S, D = q.shape
+    KVH, Sk = k.shape[1], k.shape[2]
+    block_q = min(block_q, S)
+    block_k = min(block_k, Sk)
+    nq = pl.cdiv(S, block_q)
+    nk = pl.cdiv(Sk, block_k)
+    grid = (B * H, nq, nk)
+
+    def q_map(bh, iq, ik):
+        return (bh // H, bh % H, iq, 0)
+
+    def kv_map(bh, iq, ik):
+        return (bh // H, (bh % H) * KVH // H, ik, 0)
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
+                               block_k=block_k, causal=causal, nk=nk, kv_len=Sk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), q_map),
+            pl.BlockSpec((1, 1, block_k, D), kv_map),
+            pl.BlockSpec((1, 1, block_k, D), kv_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), q_map),
+            pl.BlockSpec((1, 1, block_q), lambda bh, iq, ik: (bh // H, bh % H, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# --------------------------------------------------------------------- #
+# Backward
+# --------------------------------------------------------------------- #
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, block_q, block_k, causal, nk, kv_len):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = (not causal) or (ik * block_k <= iq * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]                 # [bq, 1]
+        delta = delta_ref[0, 0][:, None]             # [bq, 1]
+        kv_rows = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                          (block_k, 1), 0)
+        valid_kv = kv_rows < kv_len
+        k = jnp.where(valid_kv, k, 0.0)
+        v = jnp.where(valid_kv, v, 0.0)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        cols = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                       (block_q, block_k), 1)
+        mask = cols < kv_len
+        if causal:
+            rows = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                           (block_q, block_k), 0)
+            mask = mask & (rows >= cols)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)    # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, block_q, block_k, causal, nq, q_len):
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = (not causal) or (iq * block_q + block_q - 1 >= ik * block_k)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        q_rows = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                         (block_q, 1), 0)
+        valid_q = q_rows < q_len
+        q = jnp.where(valid_q, q, 0.0)
+        do = jnp.where(valid_q, do, 0.0)
+        # delta/lse of padded rows are OOB reads; 0*(garbage) must stay finite
+        delta = jnp.where(valid_q, delta, 0.0)
+        lse = jnp.where(valid_q, lse, 0.0)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        rows = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                       (block_q, block_k), 0)
+        mask = rows < q_len
+        if causal:
+            cols = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                           (block_q, block_k), 1)
+            mask = mask & (rows >= cols)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)    # [bq, bk]
+        dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                 # [bq, bk]
+        dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, res, do):
+    q, k, v, out, lse = res
+    B, H, S, D = q.shape
+    KVH, Sk = k.shape[1], k.shape[2]
+    block_q = min(block_q, S)
+    block_k = min(block_k, Sk)
+    nq = pl.cdiv(S, block_q)
+    nk = pl.cdiv(Sk, block_k)
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    def q_map(bh, iq, ik):
+        return (bh // H, bh % H, iq, 0)
+
+    def kv_map(bh, iq, ik):
+        return (bh // H, (bh % H) * KVH // H, ik, 0)
+
+    def lse_map(bh, iq, ik):
+        return (bh // H, bh % H, iq)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, causal=causal, nk=nk, kv_len=Sk),
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), q_map),
+            pl.BlockSpec((1, 1, block_k, D), kv_map),
+            pl.BlockSpec((1, 1, block_k, D), kv_map),
+            pl.BlockSpec((1, 1, block_q, D), q_map),
+            pl.BlockSpec((1, 1, block_q), lse_map),
+            pl.BlockSpec((1, 1, block_q), lse_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), q_map),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv computed per (b, h) then reduced over the query-head group for GQA
+    def kv_out_map(bh, ik, iq):
+        return (bh // H, bh % H, ik, 0)
+
+    def q_map2(bh, ik, iq):
+        return (bh // H, bh % H, iq, 0)
+
+    def kv_map2(bh, ik, iq):
+        return (bh // H, (bh % H) * KVH // H, ik, 0)
+
+    def lse_map2(bh, ik, iq):
+        return (bh // H, bh % H, iq)
+
+    dk_full, dv_full = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, causal=causal, nq=nq, q_len=S),
+        grid=(B * H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), q_map2),
+            pl.BlockSpec((1, 1, block_k, D), kv_map2),
+            pl.BlockSpec((1, 1, block_k, D), kv_map2),
+            pl.BlockSpec((1, 1, block_q, D), q_map2),
+            pl.BlockSpec((1, 1, block_q), lse_map2),
+            pl.BlockSpec((1, 1, block_q), lse_map2),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D), kv_out_map),
+            pl.BlockSpec((1, 1, block_k, D), kv_out_map),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sk, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sk, D), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    if KVH != H:
+        rep = H // KVH
+        dk = dk_full.reshape(B, KVH, rep, Sk, D).sum(axis=2)
+        dv = dv_full.reshape(B, KVH, rep, Sk, D).sum(axis=2)
+    else:
+        dk, dv = dk_full, dv_full
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# --------------------------------------------------------------------- #
+# Public API
+# --------------------------------------------------------------------- #
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
+    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
+
+
+def flash_attention(q, k, v, causal=True, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Flash attention on [B, S, H, D] tensors (model-native layout).
+
+    ``k``/``v`` may have fewer heads (GQA).  Returns [B, S, H, D].
+    """
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(D))
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash_bhsd(qt, kt, vt, float(scale), bool(causal),
+                      int(block_q), int(block_k))
+    return out.transpose(0, 2, 1, 3)
+
+
+# parity alias for the reference inference op name
+softmax_context = flash_attention

@@ -1,0 +1,158 @@
+"""Pipeline schedules — API parity with reference ``runtime/pipe/schedule.py``
+(``PipeSchedule:11``, ``InferenceSchedule:135``, ``TrainSchedule:189`` 1F1B,
+``DataParallelSchedule:301`` and the instruction dataclasses ``:327-489``).
+
+On TPU the schedule is *compiled into* the SPMD pipeline program
+(``parallel/pipeline.py``): one scan tick executes what the reference's
+interpreter dispatches as Recv→Forward→Send instruction triples.  These
+classes remain for (a) user code that introspects schedules, (b) tests that
+verify wavefront math, and (c) documentation of the instruction semantics the
+compiled program implements."""
+
+from dataclasses import dataclass
+
+
+# ---- instructions (reference schedule.py:327-489) -------------------- #
+@dataclass(frozen=True)
+class PipeInstruction:
+    stage_id: int = 0
+    micro_batch_id: int = -1
+
+    def __repr__(self):
+        return f"{type(self).__name__}(stage={self.stage_id}, mb={self.micro_batch_id})"
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class LoadMicroBatch(PipeInstruction):
+    pass
+
+
+class ForwardPass(PipeInstruction):
+    pass
+
+
+class BackwardPass(PipeInstruction):
+    pass
+
+
+class SendActivation(PipeInstruction):
+    pass
+
+
+class RecvActivation(PipeInstruction):
+    pass
+
+
+class SendGrad(PipeInstruction):
+    pass
+
+
+class RecvGrad(PipeInstruction):
+    pass
+
+
+# ---- schedules ------------------------------------------------------- #
+class PipeSchedule:
+    """Iterable of per-step instruction lists (reference ``schedule.py:11``)."""
+
+    def __init__(self, micro_batches, stages, stage_id):
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    def steps(self):
+        raise NotImplementedError
+
+    def __iter__(self):
+        return iter(self.steps())
+
+    @property
+    def num_micro_batches(self):
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def _valid_micro_batch(self, micro_batch_id):
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id):
+        return 0 <= stage_id < self.stages
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only wavefront (reference ``schedule.py:135``)."""
+
+    def steps(self):
+        total = self.micro_batches + self.stages - 1
+        out = []
+        for t in range(total):
+            cmds = []
+            m = t - self.stage_id
+            if self._valid_micro_batch(m):
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(self.stage_id, m))
+                else:
+                    cmds.append(RecvActivation(self.stage_id, m))
+                cmds.append(ForwardPass(self.stage_id, m))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(self.stage_id, m))
+            out.append(cmds)
+        return out
+
+
+class TrainSchedule(PipeSchedule):
+    """Fill-drain training wavefront with interleaved backward — the
+    instruction stream whose dataflow the compiled scan reproduces
+    (reference 1F1B ``schedule.py:189``)."""
+
+    def steps(self):
+        fwd = InferenceSchedule(self.micro_batches, self.stages,
+                                self.stage_id).steps()
+        total = self.micro_batches + self.stages - 1
+        bwd = []
+        # backward wavefront runs in reverse stage order
+        rev = self.stages - 1 - self.stage_id
+        for t in range(total):
+            cmds = []
+            m = t - rev
+            if self._valid_micro_batch(m):
+                if not self.is_last_stage:
+                    cmds.append(RecvGrad(self.stage_id, m))
+                cmds.append(BackwardPass(self.stage_id, m))
+                if not self.is_first_stage:
+                    cmds.append(SendGrad(self.stage_id, m))
+            bwd.append(cmds)
+        tail = [[ReduceTiedGrads(self.stage_id), ReduceGrads(self.stage_id),
+                 OptimizerStep(self.stage_id)]]
+        return fwd + bwd + tail
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule (reference ``schedule.py:301``)."""
+
+    def steps(self):
+        out = []
+        for m in range(self.micro_batches):
+            out.append([LoadMicroBatch(0, m), ForwardPass(0, m),
+                        BackwardPass(0, m)])
+        out.append([ReduceGrads(0), OptimizerStep(0)])
+        return out

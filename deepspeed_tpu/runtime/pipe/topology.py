@@ -1,0 +1,112 @@
+"""Pipeline topology shims — parity with reference
+``runtime/pipe/topology.py`` (``ProcessTopology:12``,
+``PipeDataParallelTopology:232``, ``PipeModelDataParallelTopology:244``,
+``PipelineParallelGrid:251``).
+
+The real topology on TPU is the named device mesh
+(``deepspeed_tpu/parallel/topology.py``); these classes provide the
+axes/coords rank-grid algebra for user code and tests that address ranks the
+Megatron way."""
+
+import itertools
+from collections import namedtuple
+
+
+class ProcessTopology:
+    """Cartesian rank grid with named axes (reference ``topology.py:12``)."""
+
+    def __init__(self, axes, dims):
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", axes)
+        self.mapping = {}
+        for coord in itertools.product(*[range(d) for d in dims]):
+            rank = 0
+            for ax, idx in enumerate(coord):
+                rank = rank * dims[ax] + idx
+            self.mapping[self.ProcessCoord(*coord)] = rank
+
+    def get_rank(self, **coord_kwargs):
+        key = self.ProcessCoord(**coord_kwargs)
+        return self.mapping[key]
+
+    def get_axis_names(self):
+        return self.axes
+
+    def get_coord(self, rank):
+        for coord, r in self.mapping.items():
+            if r == rank:
+                return coord
+        raise ValueError(f"rank {rank} not in topology")
+
+    def get_dim(self, axis):
+        return self.dims[self.axes.index(axis)]
+
+    def world_size(self):
+        out = 1
+        for d in self.dims:
+            out *= d
+        return out
+
+    def get_axis_comm_lists(self, axis):
+        """Lists of ranks varying only along ``axis`` — the rank sets the
+        reference builds communicators from (here: documentation of which
+        mesh axis a collective rides)."""
+        ax = self.axes.index(axis)
+        others = [a for a in self.axes if a != axis]
+        lists = []
+        for coord in itertools.product(*[range(self.get_dim(a)) for a in others]):
+            fixed = dict(zip(others, coord))
+            lists.append([self.get_rank(**{**fixed, axis: i})
+                          for i in range(self.dims[ax])])
+        return lists
+
+    def filter_match(self, **filter_kwargs):
+        return [rank for coord, rank in self.mapping.items()
+                if all(getattr(coord, k) == v for k, v in filter_kwargs.items())]
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    def __init__(self, num_pp, num_dp):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    def __init__(self, num_pp, num_mp, num_dp):
+        super().__init__(axes=["pipe", "data", "model"],
+                         dims=[num_pp, num_dp, num_mp])
+
+
+class PipelineParallelGrid:
+    """mpu-compatible facade (reference ``topology.py:251``) backed by the
+    live device mesh."""
+
+    def __init__(self, topology=None, process_group=None):
+        from deepspeed_tpu.parallel.topology import get_topology
+        self._mesh_topo = get_topology()
+        self.pipe_parallel_size = self._mesh_topo.pp
+        self.data_parallel_size = self._mesh_topo.dp
+        self.model_parallel_size = self._mesh_topo.tp
+
+    def get_pipe_parallel_world_size(self):
+        return self.pipe_parallel_size
+
+    def get_data_parallel_world_size(self):
+        return self.data_parallel_size
+
+    def get_model_parallel_world_size(self):
+        return self.model_parallel_size
+
+    def get_global_rank(self):
+        import jax
+        return jax.process_index()
+
+    def get_pipe_parallel_group(self):
+        return ("pp",)
+
+    def get_data_parallel_group(self):
+        from deepspeed_tpu.parallel.topology import DP_AXES
+        return DP_AXES
+
+    def get_model_parallel_group(self):
+        return ("tp",)

@@ -95,6 +95,12 @@ def apply_zero_to_spec(shape, spec, mesh, zero_axes):
 # (regex over joined path, partition spec entries by dim-from-the-right)
 # "col" = shard output features (last dim of a kernel), "row" = shard input
 # features (first dim of a 2D kernel) — Megatron column/row linear.
+# Expert-parameter contract: a path component named ``experts`` or a leaf
+# named ``experts_*`` marks a STACKED expert parameter whose dim 0 is the
+# expert dim (the layout ``moe/layer.py ExpertsMLP`` produces).  Custom
+# expert modules must follow this naming to get ep sharding.
+EXPERT_PARAM_PATTERN = r"(^|/)experts(_[a-z0-9_]+)?(/|$)"
+
 DEFAULT_TP_RULES = [
     (r"(q_proj|k_proj|v_proj|qkv|query|key|value|gate_proj|up_proj|wi|fc1|fc_in|c_fc|dense_h_to_4h).*(kernel|weight)$", "col"),
     (r"(o_proj|out_proj|down_proj|wo|fc2|fc_out|c_proj|dense_4h_to_h|attention_output|dense$).*", "row"),
@@ -118,22 +124,24 @@ def path_to_str(path):
     return "/".join(parts)
 
 
-def tp_spec_for(path_str, ndim, mesh, rules=None):
-    """PartitionSpec from TP rules for one leaf."""
-    if mesh.shape.get(TP_AXIS, 1) == 1:
+def tp_spec_for(path_str, shape, mesh, rules=None):
+    """PartitionSpec from TP rules for one leaf.  A rule only applies when
+    the target dim is divisible by the tp size (e.g. odd vocab sizes stay
+    replicated — the reference pads instead, ``replace_module.py`` weight
+    slicing asserts divisibility)."""
+    ndim = len(shape)
+    tp_size = mesh.shape.get(TP_AXIS, 1)
+    if tp_size == 1:
         return P(*([None] * ndim))
     rules = rules if rules is not None else DEFAULT_TP_RULES
     low = path_str.lower()
     for pattern, kind in rules:
         if re.search(pattern, low):
             spec = [None] * ndim
-            if kind == "col" and ndim >= 1:
-                spec[-1] = TP_AXIS
-            elif kind == "row" and ndim >= 2:
-                spec[-2] = TP_AXIS
-            elif kind == "vocab" and ndim >= 2:
-                spec[0] = TP_AXIS
-            # "replicate" leaves all None
+            dim = {"col": ndim - 1, "row": ndim - 2, "vocab": 0}.get(kind)
+            if dim is not None and dim >= 0 and shape[dim] % tp_size == 0:
+                spec[dim] = TP_AXIS
+            # "replicate" (or non-divisible) leaves all None
             return P(*spec)
     return P(*([None] * ndim))
 
@@ -196,7 +204,18 @@ def build_sharding_plan(abstract_params, topo, zero_config, tp_rules=None):
     def specs_for(path, leaf, shard_over_zero):
         shape = leaf.shape
         ps = path_to_str(path)
-        spec = tp_spec_for(ps, len(shape), mesh, tp_rules)
+        if re.search(EXPERT_PARAM_PATTERN, ps.lower()) and len(shape) >= 1 \
+                and mesh.shape[EP_AXIS] > 1 and shape[0] % mesh.shape[EP_AXIS] == 0:
+            # expert params: expert dim over 'ep', TP rules on the trailing
+            # (per-expert) dims; ZeRO restricted to edp — expert grads must
+            # never average across experts (reference ``stage_1_and_2.py:1781``
+            # expert-data-parallel averaging)
+            inner = tp_spec_for(ps, shape[1:], mesh, tp_rules)
+            spec = P(EP_AXIS, *inner)
+            if shard_over_zero:
+                spec = apply_zero_to_spec(shape, spec, mesh, (EDP_AXIS,))
+            return spec
+        spec = tp_spec_for(ps, shape, mesh, tp_rules)
         if shard_over_zero:
             spec = apply_zero_to_spec(shape, spec, mesh, zero_axes)
         return spec

@@ -1,0 +1,60 @@
+"""Elasticity tests — analog of reference ``tests/unit/elasticity/``."""
+
+import pytest
+
+from deepspeed_tpu.elasticity import (compute_elastic_config,
+                                      ElasticityConfigError,
+                                      ElasticityIncompatibleWorldSize)
+
+BASE = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def test_basic_10k():
+    batch, valid = compute_elastic_config(BASE)
+    assert batch <= 10000
+    assert len(valid) > 1
+    for w in valid:
+        assert any(batch % (mb * w) == 0
+                   for mb in BASE["elasticity"]["micro_batch_sizes"])
+
+
+def test_global_batch_invariant_across_worlds():
+    cfg = dict(BASE)
+    b1, valid = compute_elastic_config(cfg)
+    for w in valid[:5]:
+        b2, _, mb = compute_elastic_config(cfg, world_size=w, return_microbatch=True)
+        assert b2 == b1
+        gas = b1 // (mb * w)
+        assert mb * gas * w == b1
+
+
+def test_disabled_raises():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {"enabled": False}})
+
+
+def test_incompatible_world_raises():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 4,
+                          "micro_batch_sizes": [4], "min_gpus": 1,
+                          "max_gpus": 4, "version": 0.1}}
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(cfg, world_size=3)
+
+
+def test_v02_node_granularity():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 1024,
+                          "micro_batch_sizes": [4, 8], "min_gpus": 4,
+                          "max_gpus": 64, "version": 0.2,
+                          "num_gpus_per_node": 4}}
+    batch, valid = compute_elastic_config(cfg)
+    assert all(w % 4 == 0 for w in valid)

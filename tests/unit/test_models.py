@@ -1,0 +1,128 @@
+"""Model-family tests: shapes, loss finiteness, training integration with the
+engine at ZeRO-3 + TP sharding rules."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import (Transformer, TransformerConfig,
+                                              cross_entropy_loss,
+                                              reference_attention)
+from deepspeed_tpu.models.opt import opt_model, opt_config, llama_model
+
+
+def tiny_config(**over):
+    base = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                max_seq_len=64, use_flash_attention=False, dtype="float32")
+    base.update(over)
+    return TransformerConfig(**base)
+
+
+def lm_batch(bs=4, seq=16, vocab=128, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, (bs, seq)).astype(np.int32)
+    return {"input_ids": ids}
+
+
+def test_forward_loss_finite():
+    model = Transformer(tiny_config())
+    params = model.init(jax.random.key(0), lm_batch())
+    loss = model.apply(params, lm_batch())
+    assert np.isfinite(float(loss))
+    assert float(loss) == pytest.approx(np.log(128), rel=0.3)  # ~uniform at init
+
+
+def test_logits_shape():
+    cfg = tiny_config()
+    model = Transformer(cfg)
+    batch = lm_batch()
+    params = model.init(jax.random.key(0), batch)
+    logits = model.apply(params, batch["input_ids"], method=Transformer.logits)
+    assert logits.shape == (4, 16, 128)
+
+
+def test_llama_variant_forward():
+    model = Transformer(tiny_config(rms_norm=True, gated_mlp=True,
+                                    activation="silu", position_embedding="rope",
+                                    num_kv_heads=2, tie_word_embeddings=False))
+    batch = lm_batch()
+    params = model.init(jax.random.key(0), batch)
+    loss = model.apply(params, batch)
+    assert np.isfinite(float(loss))
+
+
+def test_param_count_matches_analytic():
+    cfg = tiny_config(tie_word_embeddings=True)
+    model = Transformer(cfg)
+    params = model.init(jax.random.key(0), lm_batch())
+    actual = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    # analytic count ignores small bias terms; require within 2%
+    assert abs(actual - cfg.num_params()) / actual < 0.02
+
+
+def test_opt_preset_sizes():
+    cfg = opt_config("opt-1.3b")
+    n = cfg.num_params()
+    assert 1.2e9 < n < 1.5e9, f"opt-1.3b param count off: {n/1e9:.2f}B"
+
+
+def test_cross_entropy_ignore_index():
+    logits = jnp.zeros((2, 3, 10))
+    labels = jnp.array([[1, -100, 2], [-100, -100, 3]])
+    loss = cross_entropy_loss(logits, labels)
+    assert float(loss) == pytest.approx(np.log(10), rel=1e-5)
+
+
+def test_gqa_reference_attention():
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((2, 8, 4, 16)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((2, 8, 2, 16)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((2, 8, 2, 16)).astype(np.float32))
+    out = reference_attention(q, k, v, causal=True)
+    assert out.shape == (2, 8, 4, 16)
+    # causality: output at position 0 must not depend on later keys
+    k2 = k.at[:, 5:].set(0.0)
+    v2 = v.at[:, 5:].set(0.0)
+    out2 = reference_attention(q, k2, v2, causal=True)
+    np.testing.assert_allclose(out[:, :5], out2[:, :5], rtol=1e-5)
+
+
+def test_transformer_with_engine_zero3():
+    model = Transformer(tiny_config())
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3}})
+    losses = []
+    for i in range(6):
+        batch = lm_batch(bs=8, seed=0)  # fixed batch: must memorize
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert losses[-1] < losses[0]
+
+
+def test_transformer_with_tp():
+    model = Transformer(tiny_config())
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "tensor_parallel": {"tp_size": 2},
+                "zero_optimization": {"stage": 1},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+    assert engine.topology.tp == 2 and engine.topology.dp == 4
+    batch = lm_batch(bs=8)
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()
+    # verify at least one kernel actually sharded over tp
+    from jax.sharding import PartitionSpec as P
+    leaves = jax.tree_util.tree_leaves_with_path(engine.params)
+    tp_sharded = [p for p, l in leaves
+                  if any("tp" in str(e) for e in l.sharding.spec if e is not None)]
+    assert tp_sharded, "no parameter sharded over tp axis"

@@ -1,0 +1,99 @@
+"""PipelineEngine end-to-end: pipelined transformer trains, matches the
+non-pipelined engine's semantics, and composes with ZeRO/bf16."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import TransformerConfig
+from deepspeed_tpu.models.pipeline_transformer import transformer_pipe
+from deepspeed_tpu.runtime.pipe.schedule import TrainSchedule, InferenceSchedule
+
+
+def tiny_cfg(**over):
+    base = dict(vocab_size=64, hidden_size=32, num_layers=4, num_heads=4,
+                max_seq_len=32, use_flash_attention=False, dtype="float32",
+                scan_layers=False, remat=False)
+    base.update(over)
+    return TransformerConfig(**base)
+
+
+def pipe_batch(M=2, mb=4, seq=16, vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab, (M, mb, seq)).astype(np.int32)}
+
+
+def make_engine(pp=2, M=2, zero=0, **cfg_over):
+    module = transformer_pipe(tiny_cfg(**cfg_over))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=module,
+        config={
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": M,
+            "optimizer": {"type": "Adam", "params": {"lr": 5e-3}},
+            "zero_optimization": {"stage": zero},
+            "pipeline": {"stages": pp},
+        })
+    return engine
+
+
+@pytest.mark.parametrize("pp", [2, 4])
+def test_pipeline_transformer_trains(pp):
+    engine = make_engine(pp=pp)
+    batch = pipe_batch(seed=3)
+    losses = [float(jax.device_get(engine.train_batch(batch=batch)))
+              for _ in range(6)]
+    assert losses[-1] < losses[0], f"pp={pp} no learning: {losses}"
+
+
+def test_pipeline_with_zero2():
+    engine = make_engine(pp=2, zero=2)
+    batch = pipe_batch()
+    l0 = float(jax.device_get(engine.train_batch(batch=batch)))
+    l1 = float(jax.device_get(engine.train_batch(batch=batch)))
+    assert np.isfinite(l0) and l1 < l0
+
+
+def test_pipeline_matches_dense_engine_loss():
+    """Pipelined loss at init ≈ dense-engine loss at init for the same
+    architecture (different inits → compare magnitude only)."""
+    engine = make_engine(pp=2)
+    batch = pipe_batch()
+    loss = float(jax.device_get(engine.eval_batch(batch=batch)))
+    assert abs(loss - np.log(64)) < 0.8   # ~uniform prediction at init
+
+
+def test_pipeline_forbids_forward_backward():
+    engine = make_engine(pp=2)
+    with pytest.raises(RuntimeError):
+        engine({"input_ids": np.zeros((2, 4), np.int32)})
+    with pytest.raises(RuntimeError):
+        engine.backward(0.0)
+    with pytest.raises(RuntimeError):
+        engine.step()
+
+
+def test_body_param_sharded_over_pp():
+    engine = make_engine(pp=4)
+    engine.train_batch(batch=pipe_batch())
+    body_leaves = jax.tree.leaves(engine.params["body"])
+    assert any("pp" in str(l.sharding.spec) for l in body_leaves), \
+        "body params not sharded over pp axis"
+
+
+def test_train_schedule_wavefront():
+    sched = TrainSchedule(micro_batches=4, stages=2, stage_id=0)
+    steps = sched.steps()
+    # first tick on stage 0 loads microbatch 0 and runs forward
+    names = [type(c).__name__ for c in steps[0]]
+    assert names == ["LoadMicroBatch", "ForwardPass", "SendActivation"]
+    # total fwd ticks = M + P - 1
+    fwd_ticks = 4 + 2 - 1
+    inf = InferenceSchedule(4, 2, 1).steps()
+    assert len(inf) == fwd_ticks
+    # last stage's first tick is idle (wavefront delay)
+    assert inf[0] == []
+    assert [type(c).__name__ for c in inf[1]] == ["RecvActivation", "ForwardPass"]

@@ -1,0 +1,3 @@
+from deepspeed_tpu.ops.quantizer.kernels import (
+    quantize, dequantize, fake_quantize, pack_int4, unpack_int4,
+    quantize_ternary, quantize_binary)

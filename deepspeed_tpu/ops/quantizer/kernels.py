@@ -1,0 +1,106 @@
+"""Groupwise quantization kernels.
+
+Capability parity with the reference's CUDA quantization kernels
+(``csrc/quantization/{quantize,dequantize,fake_quantizer}.cu``, bound via
+``QuantizerBuilder`` ``op_builder/quantizer.py:9``): groupwise symmetric /
+asymmetric INT8/INT4 quantize + dequantize + straight-through fake-quant.
+
+TPU-first: these are pure ``jnp`` programs — XLA fuses scale computation,
+rounding and packing into a couple of VPU loops, so no Pallas kernel is
+warranted (memory-bound elementwise work; see pallas guide "don't hand-write
+what XLA already fuses").  INT4 values are packed two-per-int8 so quantized
+buffers really are 4-bit in HBM.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _grouped(x, num_groups):
+    n = x.size
+    assert n % num_groups == 0, f"size {n} not divisible into {num_groups} groups"
+    return x.reshape(num_groups, n // num_groups)
+
+
+def quantize(x, num_groups, num_bits=8, symmetric=True):
+    """Groupwise quantize.  Returns (q, scale, zero_point) where q is int8
+    (for 4-bit, values live in [-8,7] before packing)."""
+    g = _grouped(x.astype(jnp.float32), num_groups)
+    qmax = 2.0 ** (num_bits - 1) - 1.0
+    if symmetric:
+        absmax = jnp.max(jnp.abs(g), axis=1, keepdims=True)
+        scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
+        q = jnp.clip(jnp.round(g / scale), -qmax - 1, qmax).astype(jnp.int8)
+        zero = jnp.zeros_like(scale)
+    else:
+        gmin = jnp.min(g, axis=1, keepdims=True)
+        gmax = jnp.max(g, axis=1, keepdims=True)
+        span = jnp.maximum(gmax - gmin, 1e-8)
+        scale = span / (2.0 ** num_bits - 1.0)
+        zero = gmin
+        q = jnp.clip(jnp.round((g - zero) / scale), 0, 2.0 ** num_bits - 1.0)
+        q = (q - 2.0 ** (num_bits - 1)).astype(jnp.int8)
+    return q, scale, zero
+
+
+def dequantize(q, scale, zero, num_bits=8, symmetric=True, shape=None):
+    g = q.astype(jnp.float32)
+    if symmetric:
+        out = g * scale
+    else:
+        out = (g + 2.0 ** (num_bits - 1)) * scale + zero
+    return out.reshape(shape) if shape is not None else out
+
+
+def pack_int4(q):
+    """Pack int8-held 4-bit values [-8,7] two-per-byte (low nibble first)."""
+    flat = q.reshape(q.shape[0], -1)
+    assert flat.shape[1] % 2 == 0
+    lo = (flat[:, 0::2] & 0xF).astype(jnp.uint8)
+    hi = (flat[:, 1::2] & 0xF).astype(jnp.uint8)
+    return lo | (hi << 4)
+
+
+def unpack_int4(packed):
+    lo = (packed & 0xF).astype(jnp.int8)
+    hi = ((packed >> 4) & 0xF).astype(jnp.int8)
+    # sign-extend nibbles
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    out = jnp.stack([lo, hi], axis=-1)
+    return out.reshape(packed.shape[0], -1)
+
+
+@jax.custom_vjp
+def fake_quantize(x, num_groups, num_bits):
+    q, scale, zero = quantize(x, num_groups, num_bits, symmetric=True)
+    return dequantize(q, scale, zero, num_bits, shape=x.shape).astype(x.dtype)
+
+
+def _fq_fwd(x, num_groups, num_bits):
+    return fake_quantize(x, num_groups, num_bits), None
+
+
+def _fq_bwd(_, g):
+    # straight-through estimator (reference fake_quantizer.cu semantics)
+    return (g, None, None)
+
+
+fake_quantize.defvjp(_fq_fwd, _fq_bwd)
+
+
+def quantize_ternary(x, num_groups):
+    """Ternary {-a, 0, +a} per group (reference ``quantize_tenary``)."""
+    g = _grouped(x.astype(jnp.float32), num_groups)
+    thres = 0.7 * jnp.mean(jnp.abs(g), axis=1, keepdims=True)
+    mask = (jnp.abs(g) > thres).astype(jnp.float32)
+    alpha = jnp.sum(jnp.abs(g) * mask, axis=1, keepdims=True) / \
+        jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1.0)
+    return jnp.sign(g) * mask * alpha
+
+
+def quantize_binary(x, num_groups):
+    """Binary {-a, +a} per group (reference ``quantize_binary``)."""
+    g = _grouped(x.astype(jnp.float32), num_groups)
+    alpha = jnp.mean(jnp.abs(g), axis=1, keepdims=True)
+    return jnp.sign(g) * alpha

@@ -1,0 +1,3 @@
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+    checkpoint, checkpoint_wrapper, configure, is_configured)

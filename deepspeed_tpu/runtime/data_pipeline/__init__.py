@@ -1,0 +1,5 @@
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import DeepSpeedDataSampler, DataAnalyzer
+from deepspeed_tpu.runtime.data_pipeline.data_routing import (
+    RandomLTDScheduler, random_ltd_layer, sample_kept_indices,
+    gather_tokens, scatter_tokens)

@@ -1,0 +1,122 @@
+"""Curriculum-learning difficulty scheduler.
+
+Capability parity with reference
+``deepspeed/runtime/data_pipeline/curriculum_scheduler.py:11``
+(``CurriculumScheduler``): maps global step → difficulty (typically sequence
+length) under ``fixed_linear`` / ``fixed_root`` / ``fixed_discrete`` /
+``custom`` schedules.  Pure Python host-side logic — difficulty feeds the
+engine's per-step seqlen slicing, which stays jit-friendly because each
+distinct seqlen is its own compiled program (XLA caches per shape; the
+schedule quantises via ``difficulty_step`` exactly so the number of distinct
+shapes stays small, same motivation as the reference's Tensor-Core-alignment
+note).
+"""
+
+import math
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+MIN_DIFFICULTY = "min_difficulty"
+MAX_DIFFICULTY = "max_difficulty"
+SCHEDULE_TYPE = "schedule_type"
+SCHEDULE_CONFIG = "schedule_config"
+TOTAL_STEP = "total_curriculum_step"
+DIFFICULTY_STEP = "difficulty_step"
+ROOT_DEGREE = "root_degree"
+DIFFICULTY = "difficulty"
+MAX_STEP = "max_step"
+
+
+class CurriculumScheduler:
+
+    def __init__(self, config):
+        self.state = {}
+        for key in (MIN_DIFFICULTY, MAX_DIFFICULTY, SCHEDULE_TYPE):
+            assert key in config, \
+                f"Curriculum learning requires the config '{key}'"
+        self.state[MIN_DIFFICULTY] = config[MIN_DIFFICULTY]
+        self.state[MAX_DIFFICULTY] = config[MAX_DIFFICULTY]
+        self.state["current_difficulty"] = config[MIN_DIFFICULTY]
+        self.state[SCHEDULE_TYPE] = config[SCHEDULE_TYPE]
+        self.first_step = True
+        self.custom_get_difficulty = None
+
+        stype = config[SCHEDULE_TYPE]
+        sconf = config.get(SCHEDULE_CONFIG, {})
+        if stype == FIXED_DISCRETE:
+            assert DIFFICULTY in sconf and MAX_STEP in sconf, \
+                f"fixed_discrete requires '{DIFFICULTY}' and '{MAX_STEP}'"
+            assert len(sconf[MAX_STEP]) > 0
+            assert len(sconf[DIFFICULTY]) > 0
+            assert len(sconf[DIFFICULTY]) == len(sconf[MAX_STEP]) + 1
+        elif stype == FIXED_ROOT:
+            assert TOTAL_STEP in sconf and DIFFICULTY_STEP in sconf \
+                and ROOT_DEGREE in sconf, \
+                f"fixed_root requires '{TOTAL_STEP}', '{DIFFICULTY_STEP}', '{ROOT_DEGREE}'"
+        elif stype == FIXED_LINEAR:
+            assert TOTAL_STEP in sconf and DIFFICULTY_STEP in sconf, \
+                f"fixed_linear requires '{TOTAL_STEP}', '{DIFFICULTY_STEP}'"
+        elif stype == CUSTOM:
+            pass
+        else:
+            raise RuntimeError(f"unsupported schedule type {stype}")
+        self.state[SCHEDULE_CONFIG] = sconf
+
+    def get_current_difficulty(self):
+        return self.state["current_difficulty"]
+
+    def set_current_difficulty(self, difficulty):
+        self.state["current_difficulty"] = difficulty
+
+    def set_custom_get_difficulty(self, schedule_function):
+        self.custom_get_difficulty = schedule_function
+
+    def get_state(self):
+        return self.state
+
+    def set_state(self, state):
+        self.state = state
+
+    def _fixed_discrete(self, global_steps):
+        sconf = self.state[SCHEDULE_CONFIG]
+        for i, max_step in enumerate(sconf[MAX_STEP]):
+            if global_steps <= max_step:
+                return sconf[DIFFICULTY][i]
+        return sconf[DIFFICULTY][-1]
+
+    def _fixed_root(self, global_steps, root_degree=None):
+        sconf = self.state[SCHEDULE_CONFIG]
+        if root_degree is None:
+            root_degree = sconf[ROOT_DEGREE]
+        next_difficulty = (min(1.0, global_steps / sconf[TOTAL_STEP])
+                           ** (1.0 / root_degree))
+        next_difficulty = int(next_difficulty *
+                              (self.state[MAX_DIFFICULTY] -
+                               self.state[MIN_DIFFICULTY]) +
+                              self.state[MIN_DIFFICULTY])
+        # quantise so the set of distinct difficulties (= compiled shapes on
+        # TPU) stays small
+        next_difficulty -= next_difficulty % sconf[DIFFICULTY_STEP]
+        return min(next_difficulty, self.state[MAX_DIFFICULTY])
+
+    def get_difficulty(self, global_steps):
+        stype = self.state[SCHEDULE_TYPE]
+        if stype == FIXED_DISCRETE:
+            return self._fixed_discrete(global_steps)
+        if stype == FIXED_ROOT:
+            return self._fixed_root(global_steps)
+        if stype == FIXED_LINEAR:
+            return self._fixed_root(global_steps, root_degree=1)
+        if stype == CUSTOM:
+            assert self.custom_get_difficulty is not None, \
+                "custom schedule requires set_custom_get_difficulty()"
+            return self.custom_get_difficulty(global_steps)
+        raise RuntimeError(f"unsupported schedule type {stype}")
+
+    def update_difficulty(self, global_steps):
+        if self.state["current_difficulty"] < self.state[MAX_DIFFICULTY]:
+            self.state["current_difficulty"] = self.get_difficulty(global_steps)
+        return self.state["current_difficulty"]

@@ -1,0 +1,158 @@
+"""Random layerwise token dropping (random-LTD).
+
+Capability parity with reference ``runtime/data_pipeline/data_routing/``:
+``RandomLayerTokenDrop`` (``basic_layer.py:14``), ``RandomLTDScheduler``
+(``scheduler.py:38``), and the CUDA gather/scatter kernels
+(``csrc/random_ltd/``).  TPU-first design:
+
+* The reference needs custom ``token_sort``/``gather_scatter`` CUDA kernels;
+  on TPU the same dataflow is ``jax.random.permutation`` + ``jnp.take`` /
+  scatter (``.at[].set``) — XLA lowers these to efficient dynamic-gather on
+  the VPU, no custom kernel warranted (SURVEY §2.2 random-LTD row).
+* Everything is traceable: the kept-token count is *static* per compiled
+  program (the scheduler quantises seqlen, so a handful of shapes compile).
+
+``random_ltd_fwd``/``random_ltd_restore`` are the functional core; the
+scheduler reproduces the reference's linear seqlen ramp
+(``scheduler.py:85 update_seq``).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_kept_indices(rng, seq_len, keep_len):
+    """Uniformly sample ``keep_len`` of ``seq_len`` token positions, sorted
+    ascending (the reference sorts kept tokens to preserve order —
+    ``csrc/random_ltd/token_sort.cu``)."""
+    perm = jax.random.permutation(rng, seq_len)
+    return jnp.sort(perm[:keep_len])
+
+
+def gather_tokens(hidden, idx, batch_first=True):
+    """Gather kept tokens: [B,S,H] → [B,K,H] (reference gather_scatter.cu)."""
+    if batch_first:
+        return jnp.take(hidden, idx, axis=1)
+    return jnp.take(hidden, idx, axis=0)
+
+
+def scatter_tokens(full, dropped_out, idx, batch_first=True):
+    """Scatter layer output for kept tokens back into the full-length
+    residual stream (dropped tokens keep their input values)."""
+    if batch_first:
+        return full.at[:, idx, :].set(dropped_out)
+    return full.at[idx, :, :].set(dropped_out)
+
+
+def random_ltd_layer(layer_fn, hidden, rng, keep_len, mask=None,
+                     batch_first=True):
+    """Run ``layer_fn`` on a random subset of tokens, scattering results back.
+
+    The functional analog of ``RandomLayerTokenDrop.forward``
+    (``basic_layer.py:66``): sample indices, gather tokens (and slice the
+    attention mask — ``slice_attn_masks.cu``), apply the layer, scatter.
+    """
+    seq_axis = 1 if batch_first else 0
+    seq_len = hidden.shape[seq_axis]
+    if keep_len >= seq_len:
+        out = layer_fn(hidden, mask) if mask is not None else layer_fn(hidden)
+        return out
+    idx = sample_kept_indices(rng, seq_len, keep_len)
+    sub = gather_tokens(hidden, idx, batch_first)
+    if mask is not None:
+        sub_mask = jnp.take(jnp.take(mask, idx, axis=-1), idx, axis=-2)
+        sub_out = layer_fn(sub, sub_mask)
+    else:
+        sub_out = layer_fn(sub)
+    return scatter_tokens(hidden, sub_out, idx, batch_first)
+
+
+class BaseScheduler:
+    """Reference ``scheduler.py:15``: value schedules shared with curriculum."""
+
+    def __init__(self):
+        self.state = {}
+
+    def _fixed_root_get_value(self, global_steps, root_degree=None):
+        s = self.state
+        if root_degree is None:
+            root_degree = s["schedule_config"]["root_degree"]
+        next_seq = (min(1.0, global_steps / s["schedule_config"]["total_layer_tokens_steps"])
+                    ** (1.0 / root_degree))
+        next_seq = int(next_seq * (s["max_value"] - s["min_value"]) + s["min_value"])
+        next_seq -= next_seq % s["schedule_config"]["seq_step"]
+        return min(next_seq, s["max_value"])
+
+    def get_value(self, global_steps):
+        stype = self.state["schedule_type"]
+        if stype == "fixed_linear":
+            return self._fixed_root_get_value(global_steps, 1)
+        if stype == "fixed_root":
+            return self._fixed_root_get_value(global_steps)
+        raise RuntimeError(f"unsupported schedule type {stype}")
+
+
+class RandomLTDScheduler(BaseScheduler):
+    """Reference ``scheduler.py:38``: ramps the kept-token count from
+    ``start_value`` to the full seqlen over ``total_steps``."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.model_layer_num = config["random_ltd"]["total_layer_num"]
+        self.random_ltd_layer_num = config["random_ltd"]["random_ltd_layer_num"]
+        self.config_schedule = config["random_ltd"]["random_ltd_schedule"]
+        self.max_value = self.config_schedule["max_value"]
+        self.min_value = self.config_schedule["min_value"]
+        self.current_seq = self.min_value
+        self.state = {
+            "schedule_type": self.config_schedule["schedule_type"],
+            "schedule_config": self.config_schedule["schedule_config"],
+            "max_value": self.max_value,
+            "min_value": self.min_value,
+            "current_seq": self.min_value,
+            "global_steps": 0,
+        }
+        self.reset_to_init()
+
+    def get_total_layer_tokens(self, train_iters):
+        total = 0
+        for step in range(train_iters):
+            self.update_seq(step)
+            full_layers = self.model_layer_num - self.random_ltd_layer_num
+            total += (full_layers * self.max_value
+                      + self.random_ltd_layer_num * self.current_seq)
+        return total
+
+    def reset_to_init(self):
+        self.current_seq = self.min_value
+        self.state["current_seq"] = self.min_value
+        self.state["global_steps"] = 0
+
+    def get_current_seq(self):
+        return self.current_seq
+
+    def set_current_seq(self, seq_length):
+        self.current_seq = seq_length
+        self.state["current_seq"] = seq_length
+
+    def get_random_ltd_layer_num(self):
+        return self.random_ltd_layer_num
+
+    def get_state(self):
+        return self.state
+
+    def set_state(self, state):
+        self.state = state
+        self.current_seq = state["current_seq"]
+
+    def update_seq(self, global_steps):
+        if self.current_seq < self.max_value:
+            self.set_current_seq(self.get_value(global_steps))
+        self.state["global_steps"] = global_steps
+        return self.current_seq
+
+    def state_dict(self):
+        return dict(self.state)
+
+    def load_state_dict(self, state_dict):
+        self.set_state(dict(state_dict))

@@ -1,0 +1,122 @@
+"""Curriculum-aware distributed data sampler.
+
+Capability parity with reference
+``runtime/data_pipeline/data_sampling/data_sampler.py:36``
+(``DeepSpeedDataSampler``): deterministic, resumable, difficulty-filtered
+sample selection sharded over the data-parallel axis.  The reference
+consumes offline ``DataAnalyzer`` index files; here the per-sample difficulty
+metric is supplied as a callable or array (``metric_values``) and clustering
+happens in memory — same semantics, host-side numpy (this never touches the
+device; batches it yields feed the jitted step).
+"""
+
+import numpy as np
+
+
+class DeepSpeedDataSampler:
+    """Yields per-step lists of sample indices for this dp rank.
+
+    Curriculum semantics (reference ``:165 get_new_cluster``): at each step
+    the scheduler's current difficulty gates which samples are eligible
+    (``metric <= difficulty``); eligible-but-unseen samples are shuffled
+    deterministically per difficulty cluster.
+    """
+
+    def __init__(self, curriculum_scheduler, total_samples,
+                 micro_batch_size, data_parallel_rank, data_parallel_size,
+                 gradient_accumulation_steps=1, metric_values=None,
+                 drop_last=True, seed=1234):
+        self.curriculum_scheduler = curriculum_scheduler
+        self.total_samples = int(total_samples)
+        self.micro_batch_size = int(micro_batch_size)
+        self.dp_rank = int(data_parallel_rank)
+        self.dp_size = int(data_parallel_size)
+        self.gas = int(gradient_accumulation_steps)
+        self.global_batch_size = (self.micro_batch_size * self.dp_size
+                                  * self.gas)
+        self.metric_values = (np.asarray(metric_values)
+                              if metric_values is not None else None)
+        self.drop_last = drop_last
+        self.seed = seed
+        self.consumed_samples = 0
+        self.np_rng = np.random.default_rng(seed)
+        self._order = None
+        self._order_difficulty = None
+
+    def __len__(self):
+        return self.total_samples
+
+    def state_dict(self):
+        return {
+            "consumed_samples": self.consumed_samples,
+            "curriculum": (self.curriculum_scheduler.get_state()
+                           if self.curriculum_scheduler else None),
+        }
+
+    def load_state_dict(self, state):
+        self.consumed_samples = state["consumed_samples"]
+        if self.curriculum_scheduler and state.get("curriculum"):
+            self.curriculum_scheduler.set_state(state["curriculum"])
+
+    def _eligible_order(self, difficulty):
+        """Deterministic shuffled ordering of samples eligible at this
+        difficulty (cluster analog of reference ``:226``)."""
+        if (self._order is not None
+                and self._order_difficulty == difficulty):
+            return self._order
+        if self.metric_values is None or difficulty is None:
+            idx = np.arange(self.total_samples)
+        else:
+            idx = np.nonzero(self.metric_values <= difficulty)[0]
+        rng = np.random.default_rng(self.seed + (difficulty or 0))
+        self._order = rng.permutation(idx)
+        self._order_difficulty = difficulty
+        return self._order
+
+    def get_start_end_idx(self, batch):
+        """Split a global batch among dp ranks (reference ``:122``)."""
+        per_rank = len(batch) // self.dp_size
+        start = self.dp_rank * per_rank
+        return start, start + per_rank
+
+    def __iter__(self):
+        while True:
+            step = self.consumed_samples // self.global_batch_size
+            difficulty = None
+            if self.curriculum_scheduler is not None:
+                difficulty = self.curriculum_scheduler.update_difficulty(step + 1)
+            order = self._eligible_order(difficulty)
+            if len(order) < self.global_batch_size:
+                raise RuntimeError(
+                    f"not enough eligible samples ({len(order)}) for a global "
+                    f"batch ({self.global_batch_size}) at difficulty {difficulty}")
+            offset = self.consumed_samples % len(order)
+            if offset + self.global_batch_size > len(order):
+                offset = 0  # epoch wrap within the cluster
+            batch = order[offset:offset + self.global_batch_size]
+            self.consumed_samples += self.global_batch_size
+            start, end = self.get_start_end_idx(batch)
+            yield batch[start:end].tolist()
+
+
+class DataAnalyzer:
+    """Offline per-sample difficulty metric computation (light analog of
+    reference ``data_sampling/data_analyzer.py``): maps a metric function
+    over a dataset and saves/loads the result."""
+
+    def __init__(self, dataset, metric_fn):
+        self.dataset = dataset
+        self.metric_fn = metric_fn
+
+    def run(self):
+        return np.asarray([self.metric_fn(self.dataset[i])
+                           for i in range(len(self.dataset))])
+
+    def run_and_save(self, path):
+        vals = self.run()
+        np.save(path, vals)
+        return vals
+
+    @staticmethod
+    def load(path):
+        return np.load(path)

@@ -148,6 +148,22 @@ class DeepSpeedEngine:
 
         self.monitor = MonitorMaster(self._config.monitor_config)
         self.timers = SynchronizedWallClockTimer()
+
+        # Curriculum learning (reference engine.py:1700-1708 curriculum_seqlen
+        # kwarg injection): here the engine slices the batch's sequence axis
+        # to the scheduler's current difficulty before the jitted step — each
+        # quantised seqlen is its own cached XLA program.
+        self.curriculum_scheduler = None
+        if self._config.curriculum_learning_legacy.enabled:
+            from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import \
+                CurriculumScheduler
+            cl = self._config.curriculum_learning_legacy
+            self.curriculum_scheduler = CurriculumScheduler({
+                "min_difficulty": cl.min_difficulty,
+                "max_difficulty": cl.max_difficulty,
+                "schedule_type": cl.schedule_type,
+                "schedule_config": cl.schedule_config,
+            })
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
             steps_per_output=self.steps_per_print())
@@ -401,8 +417,40 @@ class DeepSpeedEngine:
                 donate_argnums=(0,))
         return self._compiled[key]
 
+    def _curriculum_slice(self, batch, lead_dims):
+        """Slice the sequence axis of every leaf to the scheduler's current
+        difficulty (reference engine.py:1700-1708 injects curriculum_seqlen;
+        here the engine slices directly).  Only axes beyond the leading
+        ``lead_dims`` batch axes whose length equals the reference sequence
+        length (taken from ``input_ids``) are sliced — square attention
+        masks get both seq axes sliced, hidden dims are untouched.
+        Init must happen on the full-length batch *before* this runs."""
+        if (self.curriculum_scheduler is None or not self.training
+                or self._config.curriculum_learning_legacy.curriculum_type != "seqlen"):
+            return batch
+        seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
+        ref_seq = None
+        if isinstance(batch, dict) and "input_ids" in batch:
+            ref_seq = batch["input_ids"].shape[-1]
+        if ref_seq is None or seqlen >= ref_seq:
+            return batch
+
+        def slc(x):
+            if getattr(x, "ndim", 0) <= lead_dims:
+                return x
+            idx = tuple(
+                slice(0, seqlen) if d >= lead_dims and x.shape[d] == ref_seq
+                else slice(None) for d in range(x.ndim))
+            return x[idx]
+
+        return jax.tree.map(slc, batch)
+
     def forward(self, *args, **kwargs):
         self._lazy_init(args, kwargs)
+        args = tuple(self._curriculum_slice(a, 1) if _is_batch_like(a) else a
+                     for a in args)
+        kwargs = {k: self._curriculum_slice(v, 1) if _is_batch_like(v) else v
+                  for k, v in kwargs.items()}
         args = tuple(self.put_batch(a) if _is_batch_like(a) else a for a in args)
         kwargs = {k: self.put_batch(v) if _is_batch_like(v) else v
                   for k, v in kwargs.items()}
@@ -593,6 +641,7 @@ class DeepSpeedEngine:
             # batch already stacked [gas, micro_batch, ...]
             pass
         self._lazy_init((jax.tree.map(lambda x: x[0], batch),), {})
+        batch = self._curriculum_slice(batch, 2)
         batch = jax.tree.map(
             lambda x: jax.device_put(
                 jnp.asarray(x),

@@ -1,0 +1,55 @@
+"""Progressive Layer Dropping (PLD).
+
+Capability parity with reference ``runtime/progressive_layer_drop.py``
+(arXiv:2010.13369): a theta schedule that anneals keep-probability from 1.0
+toward ``theta``; models consume it as a per-layer keep probability.  For a
+jit-friendly apply, ``layer_keep_prob`` gives the closed-form per-layer
+probability and ``maybe_drop_layer`` applies stochastic identity-skip with a
+traced PRNG key (the decision is data-independent so it stays XLA-legal via
+``lax.cond``-free arithmetic blending).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+
+    def __init__(self, theta=0.5, gamma=0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})",
+                 ranks=[0])
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self):
+        return self.current_theta
+
+    def update_state(self, global_step):
+        self.current_theta = ((1.0 - self.theta)
+                              * math.exp(-self.gamma * global_step)
+                              + self.theta)
+        return self.current_theta
+
+
+def layer_keep_prob(theta, layer_idx, num_layers):
+    """Per-layer keep probability: deeper layers drop more aggressively
+    (PLD paper eq. 6: p_l = 1 - (l/L)(1 - theta))."""
+    return 1.0 - (layer_idx / max(num_layers, 1)) * (1.0 - theta)
+
+
+def maybe_drop_layer(layer_fn, x, rng, keep_prob):
+    """Stochastic-depth residual skip: with prob (1-keep_prob) the layer is
+    identity; surviving outputs are scaled 1/keep_prob so expectations match.
+    Traceable (no Python branching on traced values)."""
+    keep = jax.random.bernoulli(rng, keep_prob).astype(x.dtype)
+    out = layer_fn(x)
+    scale = keep / jnp.maximum(keep_prob, 1e-6)
+    return x + (out - x) * scale

@@ -1,0 +1,177 @@
+"""Training-time mixed-precision quantization (MoQ).
+
+Capability parity with reference ``deepspeed/runtime/quantize.py:14``
+(``Quantizer``): progressively quantize weights during training on a
+period/eigenvalue-driven schedule, shrinking target bit-width from
+``q_start_bits`` to ``q_target_bits``; supports symmetric/asymmetric and a
+mixed-fp16 ratio ramp.  Operates functionally on param pytrees (returns new
+params) rather than mutating module tensors.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quantizer.kernels import (
+    quantize as q_kernel, dequantize as dq_kernel, quantize_ternary,
+    quantize_binary)
+from deepspeed_tpu.utils.logging import logger
+
+TWO_D_PARAMS = 6
+
+
+class Quantizer:
+
+    def __init__(self, q_groups=1, q_mixed_fp16=False, q_change_ratio=0.001,
+                 q_type=0, q_rounding=0, q_verbose=False, q_eigenvalue=False,
+                 use_quantizer_kernel=True, layer_num=0,
+                 q_start_bits=16, q_target_bits=8, q_period=1000):
+        self.q_groups = q_groups
+        self.q_mixed_fp16 = q_mixed_fp16
+        self.q_change_ratio = q_change_ratio
+        self.q_type = q_type           # 0 = symmetric, 1 = asymmetric
+        self.q_rounding = q_rounding   # 0 = nearest (stochastic folds to nearest on TPU)
+        self.q_verbose = q_verbose
+        self.q_eigenvalue = q_eigenvalue
+        self.use_quantizer_kernel = use_quantizer_kernel
+        self.layer_num = layer_num
+        self.q_start_bits = q_start_bits
+        self.q_target_bits = q_target_bits
+        self.q_period = q_period
+        self.qsteps = 0
+        self.quantize_real_ratio = 1.0
+        # per-layer current bit-width state
+        self.current_bits = {}
+
+    def any_precision_switch(self):
+        """True if any layer still has bits to shed (reference ``:39``)."""
+        if not self.current_bits:
+            return self.q_start_bits > self.q_target_bits
+        return any(b > self.q_target_bits for b in self.current_bits.values())
+
+    def step(self):
+        self.qsteps += 1
+
+    def _bits_for(self, index, factor=1):
+        start = self.current_bits.get(index, self.q_start_bits)
+        # shed one bit every q_period steps (eigenvalue factor can accelerate)
+        if start > self.q_target_bits and \
+                self.qsteps >= self.q_period * factor * max(1, start - self.q_target_bits):
+            start -= 1
+            if self.q_verbose:
+                logger.info(f"[MoQ] layer {index} -> {start} bits at step {self.qsteps}")
+        self.current_bits[index] = start
+        return start
+
+    def compute_quantization(self, x, index=0, factor=1):
+        """Quantize-dequantize one tensor at its current scheduled bit-width
+        (reference ``:129``)."""
+        bits = self._bits_for(index, factor)
+        if bits >= 16:
+            return x
+        groups = min(self.q_groups, max(1, x.size))
+        while x.size % groups != 0:
+            groups -= 1
+        if bits == 2:
+            q = quantize_ternary(x, groups).reshape(x.shape).astype(x.dtype)
+        elif bits == 1:
+            q = quantize_binary(x, groups).reshape(x.shape).astype(x.dtype)
+        else:
+            qv, scale, zero = q_kernel(x, groups, bits,
+                                       symmetric=(self.q_type == 0))
+            q = dq_kernel(qv, scale, zero, bits,
+                          symmetric=(self.q_type == 0),
+                          shape=x.shape).astype(x.dtype)
+        if self.q_mixed_fp16 and self.quantize_real_ratio > 0.0:
+            q = self.quantize_real_ratio * x + (1 - self.quantize_real_ratio) * q
+        return q
+
+    def update_fp16_ratio(self):
+        if self.q_mixed_fp16:
+            self.quantize_real_ratio = max(
+                0.0, self.quantize_real_ratio - self.q_change_ratio)
+
+    def quantize(self, params, overflow=False, eigenvalue_enabled=False,
+                 block_eigenvalue=None):
+        """Quantize a parameter pytree in place of the reference's
+        parameter_group loop (``:51``).  Skips on overflow steps (unstable
+        scales).  2-D matmul weights only — biases/norms stay high precision
+        (reference quantizes `dim>1` params only)."""
+        if overflow and not eigenvalue_enabled:
+            return params
+        self.step()
+        block_eigenvalue = block_eigenvalue or {}
+        leaves, treedef = jax.tree.flatten(params)
+        out = []
+        idx = 0
+        for leaf in leaves:
+            if leaf.ndim > 1 and leaf.size >= TWO_D_PARAMS:
+                ev = block_eigenvalue.get(idx)
+                factor = 1 if ev is None else max(1, int(1.0 / max(ev, 1e-6)))
+                out.append(self.compute_quantization(leaf, idx, factor))
+                idx += 1
+            else:
+                out.append(leaf)
+        self.update_fp16_ratio()
+        return jax.tree.unflatten(treedef, out)
+
+
+class Eigenvalue:
+    """Power-iteration estimate of per-block loss-curvature eigenvalues,
+    driving the MoQ schedule (reference ``runtime/eigenvalue.py:12``).
+
+    The reference autograd-hooks a torch module; here ``compute_eigenvalue``
+    takes a loss function over params and a param pytree, and runs
+    Hessian-vector-product power iteration with ``jax.jvp`` over
+    ``jax.grad`` — fully jittable.
+    """
+
+    def __init__(self, verbose=False, max_iter=100, tol=1e-2, stability=1e-6,
+                 gas_boundary_resolution=1, layer_name="", layer_num=0):
+        self.verbose = verbose
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.gas_boundary_resolution = gas_boundary_resolution
+        self.layer_name = layer_name
+        self.layer_num = layer_num
+
+    def normalize(self, v):
+        norm = jnp.sqrt(sum(jnp.vdot(x, x) for x in jax.tree.leaves(v)).real)
+        norm = jnp.maximum(norm, self.stability)
+        return jax.tree.map(lambda x: x / norm, v), norm
+
+    def compute_eigenvalue(self, loss_fn, params, seed=0):
+        """Dominant Hessian eigenvalue of ``loss_fn(params)`` via power
+        iteration on HVPs.  Returns a float."""
+        grad_fn = jax.grad(loss_fn)
+
+        def hvp(v):
+            return jax.jvp(grad_fn, (params,), (v,))[1]
+
+        key = jax.random.key(seed)
+        keys = jax.random.split(key, len(jax.tree.leaves(params)))
+        leaves, treedef = jax.tree.flatten(params)
+        v = jax.tree.unflatten(treedef, [
+            jax.random.normal(k, l.shape, jnp.float32)
+            for k, l in zip(keys, leaves)])
+        v, _ = self.normalize(v)
+        eig = 0.0
+        for _ in range(self.max_iter):
+            hv = hvp(v)
+            v, norm = self.normalize(hv)
+            new_eig = float(norm)
+            if eig > 0 and abs(new_eig - eig) / eig < self.tol:
+                eig = new_eig
+                break
+            eig = new_eig
+        return eig
+
+    def post_process(self, value_list):
+        """Replace zeros/NaN with the max eigenvalue, normalize to max=1
+        (reference ``:147``)."""
+        import math
+        vals = [0.0 if (v is None or math.isnan(v)) else v for v in value_list]
+        mx = max(vals) if vals else 1.0
+        if mx <= 0:
+            return [1.0 for _ in vals]
+        return [(v if v > 0 else mx) / mx for v in vals]

@@ -1,0 +1,94 @@
+"""Activation checkpointing tests — the analog of the reference's
+``tests/unit/runtime/activation_checkpointing/test_activation_checkpointing.py``:
+checkpointed forward/backward must match the non-checkpointed one exactly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    checkpointing.reset()
+    yield
+    checkpointing.reset()
+
+
+def _mlp(params, x):
+    h = jnp.tanh(x @ params["w1"])
+    return jnp.sum(h @ params["w2"])
+
+
+def _params():
+    rng = np.random.default_rng(0)
+    return {
+        "w1": jnp.asarray(rng.normal(size=(16, 32)), jnp.float32),
+        "w2": jnp.asarray(rng.normal(size=(32, 8)), jnp.float32),
+    }
+
+
+def test_configure_and_is_configured():
+    assert not checkpointing.is_configured()
+    checkpointing.configure(None, deepspeed_config={
+        "activation_checkpointing": {"partition_activations": True,
+                                     "cpu_checkpointing": False}})
+    assert checkpointing.is_configured()
+    assert checkpointing.get_config()["partition_activations"]
+
+
+def test_checkpoint_matches_plain_grads():
+    checkpointing.configure(None)
+    params = _params()
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 16)), jnp.float32)
+
+    plain = jax.grad(lambda p: _mlp(p, x))(params)
+    ckpt = jax.grad(lambda p: checkpointing.checkpoint(_mlp, p, x))(params)
+    for k in plain:
+        np.testing.assert_allclose(plain[k], ckpt[k], rtol=1e-6)
+
+
+def test_checkpoint_inside_jit():
+    checkpointing.configure(None)
+    params = _params()
+    x = jnp.ones((2, 16), jnp.float32)
+
+    @jax.jit
+    def step(p):
+        return jax.grad(lambda q: checkpointing.checkpoint(_mlp, q, x))(p)
+
+    g = step(params)
+    assert g["w1"].shape == (16, 32)
+
+
+def test_cpu_checkpointing_policy_still_correct():
+    checkpointing.configure(None, checkpoint_in_cpu=True)
+    params = _params()
+    x = jnp.ones((2, 16), jnp.float32)
+    plain = jax.grad(lambda p: _mlp(p, x))(params)
+    ckpt = jax.grad(lambda p: checkpointing.checkpoint(_mlp, p, x))(params)
+    np.testing.assert_allclose(plain["w2"], ckpt["w2"], rtol=1e-6)
+
+
+def test_checkpoint_wrapper():
+    checkpointing.configure(None)
+    fn = checkpointing.checkpoint_wrapper(_mlp)
+    params = _params()
+    x = jnp.ones((2, 16), jnp.float32)
+    assert np.isfinite(float(fn(params, x)))
+
+
+def test_rng_tracker_fork_deterministic():
+    t1 = checkpointing.model_parallel_cuda_manual_seed(1234)
+    with t1.fork() as k1:
+        a = jax.random.normal(k1, (4,))
+    t2 = checkpointing.model_parallel_cuda_manual_seed(1234)
+    with t2.fork() as k2:
+        b = jax.random.normal(k2, (4,))
+    np.testing.assert_array_equal(a, b)
+    # a second fork yields a *different* stream
+    with t2.fork() as k3:
+        c = jax.random.normal(k3, (4,))
+    assert not np.allclose(b, c)

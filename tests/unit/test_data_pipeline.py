@@ -1,0 +1,200 @@
+"""Data-efficiency pipeline tests — analog of the reference's
+``tests/unit/runtime/test_data_efficiency.py``: curriculum schedules,
+curriculum data sampler, and random-LTD token routing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline import (
+    CurriculumScheduler, DeepSpeedDataSampler, DataAnalyzer,
+    RandomLTDScheduler, random_ltd_layer, sample_kept_indices,
+    gather_tokens, scatter_tokens)
+
+
+# ------------------------- curriculum scheduler ------------------------- #
+def _sched(stype="fixed_linear", **extra):
+    cfg = {
+        "min_difficulty": 8,
+        "max_difficulty": 64,
+        "schedule_type": stype,
+        "schedule_config": extra,
+    }
+    return CurriculumScheduler(cfg)
+
+
+def test_fixed_linear_ramps_and_quantises():
+    s = _sched(total_curriculum_step=100, difficulty_step=8)
+    d0 = s.update_difficulty(1)
+    d50 = s.update_difficulty(50)
+    d100 = s.update_difficulty(100)
+    d200 = s.update_difficulty(200)
+    assert d0 >= 8 and d50 > d0 and d100 == 64 and d200 == 64
+    assert all(d % 8 == 0 for d in (d0, d50, d100))
+
+
+def test_fixed_root_slower_than_linear_early():
+    lin = _sched(total_curriculum_step=100, difficulty_step=1)
+    root = _sched("fixed_root", total_curriculum_step=100, difficulty_step=1,
+                  root_degree=2)
+    # sqrt schedule reaches difficulty faster early on
+    assert root.get_difficulty(25) >= lin.get_difficulty(25)
+
+
+def test_fixed_discrete():
+    s = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_type": "fixed_discrete",
+        "schedule_config": {"difficulty": [8, 16, 64], "max_step": [10, 20]},
+    })
+    assert s.get_difficulty(5) == 8
+    assert s.get_difficulty(15) == 16
+    assert s.get_difficulty(50) == 64
+
+
+def test_custom_schedule_and_state_roundtrip():
+    s = _sched("custom")
+    s.set_custom_get_difficulty(lambda step: min(64, step))
+    assert s.get_difficulty(30) == 30
+    state = s.get_state()
+    s2 = _sched("custom")
+    s2.set_state(state)
+    assert s2.get_current_difficulty() == s.get_current_difficulty()
+
+
+# --------------------------- data sampler ------------------------------ #
+def test_sampler_respects_difficulty_and_dp_shard():
+    metric = np.arange(100)  # sample i has difficulty i
+    sched = CurriculumScheduler({
+        "min_difficulty": 16, "max_difficulty": 100,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 10, "difficulty_step": 1},
+    })
+    samplers = [DeepSpeedDataSampler(
+        sched if r == 0 else CurriculumScheduler({
+            "min_difficulty": 16, "max_difficulty": 100,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 10,
+                                "difficulty_step": 1}}),
+        total_samples=100, micro_batch_size=2, data_parallel_rank=r,
+        data_parallel_size=2, metric_values=metric) for r in range(2)]
+    its = [iter(s) for s in samplers]
+    b0, b1 = next(its[0]), next(its[1])
+    # shards are disjoint, all samples eligible at current difficulty
+    assert set(b0).isdisjoint(b1)
+    diff = samplers[0].curriculum_scheduler.get_current_difficulty()
+    assert all(metric[i] <= diff for i in b0 + b1)
+
+
+def test_sampler_state_dict_resume():
+    s = DeepSpeedDataSampler(None, total_samples=64, micro_batch_size=4,
+                             data_parallel_rank=0, data_parallel_size=1)
+    it = iter(s)
+    next(it), next(it)
+    state = s.state_dict()
+    b3 = next(it)
+    s2 = DeepSpeedDataSampler(None, total_samples=64, micro_batch_size=4,
+                              data_parallel_rank=0, data_parallel_size=1)
+    s2.load_state_dict(state)
+    assert next(iter(s2)) == b3
+
+
+def test_data_analyzer(tmp_path):
+    data = [np.arange(i + 1) for i in range(10)]
+    da = DataAnalyzer(data, metric_fn=len)
+    path = str(tmp_path / "metric.npy")
+    vals = da.run_and_save(path)
+    np.testing.assert_array_equal(DataAnalyzer.load(path), vals)
+    assert vals[3] == 4
+
+
+# ---------------------------- random-LTD ------------------------------- #
+def test_random_ltd_scheduler_ramp():
+    s = RandomLTDScheduler({"random_ltd": {
+        "total_layer_num": 12, "random_ltd_layer_num": 10,
+        "random_ltd_schedule": {
+            "min_value": 128, "max_value": 512,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_layer_tokens_steps": 100,
+                                "seq_step": 16}},
+    }})
+    assert s.get_current_seq() == 128
+    s.update_seq(50)
+    mid = s.get_current_seq()
+    assert 128 < mid < 512 and mid % 16 == 0
+    s.update_seq(200)
+    assert s.get_current_seq() == 512
+    sd = s.state_dict()
+    s.reset_to_init()
+    assert s.get_current_seq() == 128
+    s.load_state_dict(sd)
+    assert s.get_current_seq() == 512
+
+
+def test_gather_scatter_roundtrip():
+    rng = jax.random.key(0)
+    x = jnp.arange(2 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 4)
+    idx = sample_kept_indices(rng, 8, 5)
+    assert idx.shape == (5,) and bool(jnp.all(idx[1:] > idx[:-1]))
+    sub = gather_tokens(x, idx)
+    assert sub.shape == (2, 5, 4)
+    back = scatter_tokens(x, sub * 0, idx)
+    # scattered positions zeroed, others untouched
+    kept = set(np.asarray(idx).tolist())
+    for t in range(8):
+        if t in kept:
+            assert float(jnp.sum(jnp.abs(back[:, t]))) == 0.0
+        else:
+            np.testing.assert_array_equal(back[:, t], x[:, t])
+
+
+def test_random_ltd_layer_applies_to_subset_only():
+    x = jnp.ones((2, 16, 4), jnp.float32)
+    out = random_ltd_layer(lambda h: h + 1.0, x, jax.random.key(1), keep_len=6)
+    ones = float(jnp.sum(out == 1.0)) / 4 / 2
+    twos = float(jnp.sum(out == 2.0)) / 4 / 2
+    assert twos == 6 and ones == 10
+
+
+def test_random_ltd_layer_full_keep_is_identity_path():
+    x = jnp.ones((2, 8, 4), jnp.float32)
+    out = random_ltd_layer(lambda h: h * 3, x, jax.random.key(0), keep_len=8)
+    np.testing.assert_allclose(out, x * 3)
+
+
+def test_random_ltd_inside_jit_with_mask():
+    x = jnp.ones((1, 8, 4), jnp.float32)
+    mask = jnp.ones((1, 1, 8, 8), jnp.float32)
+
+    @jax.jit
+    def f(h, m, key):
+        return random_ltd_layer(
+            lambda s, sm: s * jnp.mean(sm), h, key, keep_len=4, mask=m)
+
+    out = f(x, mask, jax.random.key(2))
+    assert out.shape == x.shape
+
+
+# ----------------------- engine curriculum wiring ---------------------- #
+def test_engine_curriculum_slices_seq(eight_devices):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import Transformer, TransformerConfig
+
+    model = Transformer(TransformerConfig(
+        vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+        max_seq_len=32, use_flash_attention=False))
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "curriculum_learning": {
+            "enabled": True, "min_difficulty": 8, "max_difficulty": 32,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4,
+                                "difficulty_step": 8}},
+    })
+    ids = np.random.default_rng(0).integers(0, 64, (1, 16, 32))
+    loss = engine.train_batch(batch={"input_ids": jnp.asarray(ids, jnp.int32)})
+    assert np.isfinite(float(loss))
+    assert engine.curriculum_scheduler.get_current_difficulty() <= 32

@@ -1,0 +1,118 @@
+"""Quantization tests — analog of reference ``tests/unit/ops/quantizer/`` and
+``tests/unit/runtime/test_ds_config`` MoQ paths: kernels vs fp32 reference,
+MoQ schedule, eigenvalue power iteration, PLD schedule."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.quantizer import (
+    quantize, dequantize, fake_quantize, pack_int4, unpack_int4,
+    quantize_ternary, quantize_binary)
+from deepspeed_tpu.runtime.quantize import Quantizer, Eigenvalue
+from deepspeed_tpu.runtime.progressive_layer_drop import (
+    ProgressiveLayerDrop, layer_keep_prob, maybe_drop_layer)
+
+
+def test_int8_symmetric_roundtrip_error_small():
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(16, 64)), jnp.float32)
+    q, s, z = quantize(x, num_groups=16, num_bits=8)
+    assert q.dtype == jnp.int8
+    back = dequantize(q, s, z, 8, shape=x.shape)
+    err = float(jnp.max(jnp.abs(back - x)))
+    # max error bounded by half a quantization step per group
+    step = float(jnp.max(s))
+    assert err <= step * 0.51 + 1e-6
+
+
+def test_int8_asymmetric_roundtrip():
+    x = jnp.asarray(np.random.default_rng(1).uniform(2.0, 3.0, (8, 32)), jnp.float32)
+    q, s, z = quantize(x, 8, 8, symmetric=False)
+    back = dequantize(q, s, z, 8, symmetric=False, shape=x.shape)
+    assert float(jnp.max(jnp.abs(back - x))) < float(jnp.max(s)) * 0.51 + 1e-6
+
+
+def test_int4_pack_unpack_roundtrip():
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(4, 32)), jnp.float32)
+    q, s, z = quantize(x, 4, num_bits=4)
+    packed = pack_int4(q)
+    assert packed.shape == (4, 16) and packed.dtype == jnp.uint8
+    unpacked = unpack_int4(packed)
+    np.testing.assert_array_equal(np.asarray(unpacked), np.asarray(q))
+
+
+def test_fake_quantize_straight_through_grad():
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(64,)), jnp.float32)
+    g = jax.grad(lambda t: jnp.sum(fake_quantize(t, 4, 8) * 2.0))(x)
+    np.testing.assert_allclose(np.asarray(g), 2.0 * np.ones(64), rtol=1e-6)
+
+
+def test_ternary_binary_shapes():
+    x = jnp.asarray(np.random.default_rng(4).normal(size=(8, 16)), jnp.float32)
+    t = quantize_ternary(x, 8)
+    b = quantize_binary(x, 8)
+    assert t.shape == (8, 16) and b.shape == (8, 16)
+    # binary has exactly one magnitude per group
+    mags = np.unique(np.round(np.abs(np.asarray(b[0])), 5))
+    assert len(mags) == 1
+
+
+def test_moq_quantizer_bit_schedule():
+    qz = Quantizer(q_groups=4, q_start_bits=10, q_target_bits=8, q_period=2)
+    params = {"w": jnp.ones((8, 8), jnp.float32),
+              "b": jnp.ones((8,), jnp.float32)}
+    assert qz.any_precision_switch()
+    for _ in range(30):
+        params = qz.quantize(params)
+    assert qz.current_bits[0] == 8
+    assert not qz.any_precision_switch()
+    # bias untouched by quantization
+    np.testing.assert_array_equal(np.asarray(params["b"]), np.ones(8))
+
+
+def test_moq_skips_on_overflow():
+    qz = Quantizer(q_start_bits=8, q_target_bits=8)
+    params = {"w": jnp.ones((4, 4))}
+    out = qz.quantize(params, overflow=True)
+    assert out is params
+
+
+def test_eigenvalue_power_iteration_quadratic():
+    # loss = 0.5 x^T A x with known dominant eigenvalue
+    A = jnp.diag(jnp.asarray([5.0, 2.0, 1.0]))
+    loss = lambda p: 0.5 * p["x"] @ A @ p["x"]
+    ev = Eigenvalue(max_iter=200, tol=1e-4)
+    val = ev.compute_eigenvalue(loss, {"x": jnp.ones(3)})
+    assert abs(val - 5.0) < 0.1
+
+
+def test_eigenvalue_post_process():
+    ev = Eigenvalue()
+    out = ev.post_process([2.0, 0.0, float("nan"), 4.0])
+    assert out == [0.5, 1.0, 1.0, 1.0]
+
+
+def test_pld_theta_anneals():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0
+    pld.update_state(0)
+    assert abs(pld.get_theta() - 1.0) < 1e-6
+    pld.update_state(10_000)
+    assert abs(pld.get_theta() - 0.5) < 1e-2
+    assert pld.get_state()["progressive_layer_drop"]
+
+
+def test_layer_keep_prob_monotone_in_depth():
+    ps = [layer_keep_prob(0.6, i, 12) for i in range(12)]
+    assert ps[0] == 1.0 and all(a >= b for a, b in zip(ps, ps[1:]))
+
+
+def test_maybe_drop_layer_expectation():
+    x = jnp.ones((4,), jnp.float32)
+    layer = lambda t: t * 3.0
+    outs = []
+    for i in range(200):
+        outs.append(maybe_drop_layer(layer, x, jax.random.key(i), 0.5))
+    mean = float(jnp.mean(jnp.stack(outs)))
+    # E[out] = x + E[keep/p](out-x) = 3.0
+    assert abs(mean - 3.0) < 0.45

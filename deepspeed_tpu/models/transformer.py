@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
+from deepspeed_tpu.utils.logging import logger
+
 
 @dataclass(frozen=True)
 class TransformerConfig:
@@ -47,6 +49,7 @@ class TransformerConfig:
     tie_word_embeddings: bool = False
     dtype: str = "bfloat16"
     use_flash_attention: bool = True
+    sparse_attention: Optional[object] = None  # SparsityConfig → block-sparse
     remat: bool = True
     remat_policy: str = "nothing_saveable"
     scan_layers: bool = True
@@ -127,6 +130,21 @@ def reference_attention(q, k, v, causal=True, mask=None):
 
 
 def _attention(q, k, v, config, mask=None):
+    if config.sparse_attention is not None and q.shape[1] > 1:
+        from deepspeed_tpu.ops.sparse_attention.block_sparse import (
+            block_sparse_attention, cached_layout)
+        sc = config.sparse_attention
+        if mask is not None and mask.ndim != 2:
+            logger.warning(
+                "sparse_attention only folds 2-D key-padding masks; got a "
+                f"{mask.ndim}-D mask — falling back to dense attention")
+        else:
+            layout = cached_layout(sc, q.shape[1], causal=True)
+            if k.shape[2] != q.shape[2]:  # GQA: expand kv heads for the kernel
+                k = jnp.repeat(k, q.shape[2] // k.shape[2], axis=2)
+                v = jnp.repeat(v, q.shape[2] // v.shape[2], axis=2)
+            return block_sparse_attention(q, k, v, layout, sc.block,
+                                          causal=True, key_padding_mask=mask)
     if config.use_flash_attention and q.shape[1] > 1 and mask is None:
         from deepspeed_tpu.ops.transformer.flash_attention import (
             flash_attention, pallas_supported)
@@ -174,6 +192,14 @@ class Attention(nn.Module):
         if cfg.position_embedding == "rope":
             q, k = _rope(q, k, positions, D, cfg.rope_theta)
         if cache is not None:
+            if cfg.sparse_attention is not None:
+                # KV-cache decode attends densely over the cache; a
+                # sparse-trained model sees a (slightly) different pattern
+                # at generation time.  Surface it instead of silently
+                # diverging.
+                logger.warning(
+                    "sparse_attention model decoding with dense KV-cache "
+                    "attention — train/decode attention patterns differ")
             # write this step's k/v at the current position, attend over cache
             start = positions[0, 0]
             k_cache = jax.lax.dynamic_update_slice(

@@ -1,0 +1,74 @@
+"""SparseSelfAttention module.
+
+Parity with reference
+``deepspeed/ops/sparse_attention/sparse_self_attention.py:12``
+(``SparseSelfAttention(Module)``) and the drop-in helpers in
+``sparse_attention_utils.py``: applies block-sparse attention under a
+``SparsityConfig``.  Functional core + a thin flax wrapper so it slots into
+model definitions the way the reference slots into BERT self-attention.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.sparse_attention.block_sparse import (
+    block_sparse_attention)
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    SparsityConfig, FixedSparsityConfig)
+
+
+class SparseAttentionFn:
+    """Callable holding a config + cached layouts per seq_len (the reference
+    caches master_layout/ops per seq_len too)."""
+
+    def __init__(self, sparsity_config=None, key_padding_mask_mode="add",
+                 attn_mask_mode="mul"):
+        self.sparsity_config = sparsity_config or FixedSparsityConfig(num_heads=4)
+        assert isinstance(self.sparsity_config, SparsityConfig)
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self._layouts = {}
+
+    def get_layout(self, seq_len):
+        from deepspeed_tpu.ops.sparse_attention.block_sparse import cached_layout
+        return cached_layout(self.sparsity_config, seq_len)
+
+    def __call__(self, query, key, value, key_padding_mask=None,
+                 attn_mask=None):
+        """query/key/value: [B, S, H, D].  ``key_padding_mask`` [B, S]
+        (1 = attend) is folded into the kernel via a k-bias feature — see
+        ``block_sparse_attention``."""
+        B, S, H, D = query.shape
+        layout = self.get_layout(S)
+        causal = getattr(self.sparsity_config, "attention",
+                         "bidirectional") == "unidirectional"
+        return block_sparse_attention(query, key, value, layout,
+                                      self.sparsity_config.block,
+                                      causal=causal,
+                                      key_padding_mask=key_padding_mask)
+
+
+class SparseSelfAttention(nn.Module):
+    """Flax module: projects hidden → q,k,v, applies block-sparse attention,
+    projects back (the reference module takes pre-projected q,k,v; this
+    wrapper covers the full BertSparseSelfAttention use too)."""
+
+    hidden_size: int
+    num_heads: int
+    sparsity_config: SparsityConfig = None
+    dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, hidden, key_padding_mask=None):
+        B, S, _ = hidden.shape
+        H = self.num_heads
+        D = self.hidden_size // H
+        dt = jnp.dtype(self.dtype)
+        qkv = nn.Dense(3 * self.hidden_size, dtype=dt, name="qkv")(hidden)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        fn = SparseAttentionFn(self.sparsity_config
+                               or FixedSparsityConfig(num_heads=H))
+        out = fn(q.reshape(B, S, H, D), k.reshape(B, S, H, D),
+                 v.reshape(B, S, H, D), key_padding_mask=key_padding_mask)
+        out = out.reshape(B, S, self.hidden_size)
+        return nn.Dense(self.hidden_size, dtype=dt, name="out")(out)

@@ -1,0 +1,213 @@
+"""Block-sparse attention tests — analog of reference
+``tests/unit/ops/sparse_attention/test_sparse_attention.py``: layouts are
+sane, kernel matches the dense-masked reference, gradients flow, module runs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (
+    DenseSparsityConfig, FixedSparsityConfig, VariableSparsityConfig,
+    BigBirdSparsityConfig, BSLongformerSparsityConfig,
+    LocalSlidingWindowSparsityConfig, block_sparse_attention,
+    sparse_attention_reference, layout_tables, SparseSelfAttention,
+    SparseAttentionFn)
+
+BLOCK = 16  # small block for CPU-interpreter speed
+
+
+def _qkv(B=2, S=64, H=2, D=8, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+# ------------------------------ layouts -------------------------------- #
+def test_dense_layout_all_ones():
+    lay = DenseSparsityConfig(num_heads=2, block=BLOCK).make_layout(64)
+    assert lay.shape == (2, 4, 4) and lay.sum() == 32
+
+
+def test_fixed_layout_local_and_global():
+    cfg = FixedSparsityConfig(num_heads=2, block=BLOCK, num_local_blocks=2,
+                              num_global_blocks=1)
+    lay = cfg.make_layout(128)  # 8 blocks
+    assert lay.shape == (2, 8, 8)
+    # local: diagonal 2x2 chunks present
+    assert lay[0, 0, 0] == 1 and lay[0, 1, 0] == 1
+    # global: column of each chunk's last block reaches all rows
+    assert lay[0, :, 1].all()
+
+
+def test_fixed_unidirectional_lower_triangular():
+    cfg = FixedSparsityConfig(num_heads=1, block=BLOCK, num_local_blocks=4,
+                              attention="unidirectional")
+    lay = cfg.make_layout(128)
+    assert np.array_equal(lay, np.tril(lay))
+
+
+def test_variable_layout_windows_and_random():
+    cfg = VariableSparsityConfig(num_heads=1, block=BLOCK,
+                                 num_random_blocks=1,
+                                 local_window_blocks=[1, 2],
+                                 global_block_indices=[0])
+    lay = cfg.make_layout(128)
+    assert lay[0, :, 0].all()          # global col
+    assert lay[0, 0, 0] == 1           # first local window
+
+
+def test_bigbird_layout():
+    cfg = BigBirdSparsityConfig(num_heads=1, block=BLOCK, num_random_blocks=1,
+                                num_sliding_window_blocks=3,
+                                num_global_blocks=1)
+    lay = cfg.make_layout(128)
+    assert lay[0, 0, :].all() and lay[0, :, 0].all()    # global row+col
+    for r in range(1, 7):
+        assert lay[0, r, r] == 1 and lay[0, r, r - 1] == 1  # window
+
+
+def test_longformer_layout():
+    cfg = BSLongformerSparsityConfig(num_heads=1, block=BLOCK,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[0])
+    lay = cfg.make_layout(128)
+    assert lay[0, :, 0].all() and lay[0, 0, :].all()
+
+
+def test_sliding_window_layout_causal():
+    cfg = LocalSlidingWindowSparsityConfig(num_heads=1, block=BLOCK,
+                                           num_sliding_window_blocks=2,
+                                           attention="unidirectional")
+    lay = cfg.make_layout(128)
+    assert np.array_equal(lay, np.tril(lay))
+    assert lay[0, 5, 5] == 1 and lay[0, 5, 4] == 1 and lay[0, 5, 3] == 0
+
+
+def test_layout_tables_roundtrip():
+    lay = np.asarray([[[1, 0, 1], [0, 1, 0], [1, 1, 1]]])
+    idx, counts = layout_tables(lay)
+    assert counts.tolist() == [[2, 1, 3]]
+    assert idx[0, 0, :2].tolist() == [0, 2]
+    assert idx[0, 2].tolist() == [0, 1, 2]
+
+
+def test_seq_not_divisible_raises():
+    with pytest.raises(ValueError):
+        DenseSparsityConfig(num_heads=1, block=BLOCK).make_layout(65)
+
+
+# ------------------------------ kernel --------------------------------- #
+@pytest.mark.parametrize("causal", [False, True])
+def test_dense_layout_matches_reference(causal):
+    q, k, v = _qkv()
+    lay = DenseSparsityConfig(num_heads=2, block=BLOCK).make_layout(64)
+    out = block_sparse_attention(q, k, v, lay, BLOCK, causal=causal)
+    ref = sparse_attention_reference(q, k, v, lay, BLOCK, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("cfg_fn", [
+    lambda: FixedSparsityConfig(num_heads=2, block=BLOCK, num_local_blocks=2),
+    lambda: BigBirdSparsityConfig(num_heads=2, block=BLOCK,
+                                  num_random_blocks=1,
+                                  num_sliding_window_blocks=3),
+    lambda: BSLongformerSparsityConfig(num_heads=2, block=BLOCK),
+])
+def test_sparse_layouts_match_reference(cfg_fn):
+    q, k, v = _qkv(S=64)
+    lay = cfg_fn().make_layout(64)
+    out = block_sparse_attention(q, k, v, lay, BLOCK)
+    ref = sparse_attention_reference(q, k, v, lay, BLOCK)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_causal_sliding_window_matches_reference():
+    q, k, v = _qkv(S=64)
+    cfg = LocalSlidingWindowSparsityConfig(num_heads=2, block=BLOCK,
+                                           num_sliding_window_blocks=2,
+                                           attention="unidirectional")
+    lay = cfg.make_layout(64)
+    out = block_sparse_attention(q, k, v, lay, BLOCK, causal=True)
+    ref = sparse_attention_reference(q, k, v, lay, BLOCK, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_gradients_match_reference():
+    q, k, v = _qkv(S=32, H=1)
+    lay = FixedSparsityConfig(num_heads=1, block=BLOCK,
+                              num_local_blocks=2).make_layout(32)
+
+    def loss_sparse(q, k, v):
+        return jnp.sum(block_sparse_attention(q, k, v, lay, BLOCK) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(sparse_attention_reference(q, k, v, lay, BLOCK) ** 2)
+
+    gs = jax.grad(loss_sparse, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=1e-4)
+
+
+def test_inside_jit():
+    q, k, v = _qkv(S=32, H=1)
+    lay = DenseSparsityConfig(num_heads=1, block=BLOCK).make_layout(32)
+    f = jax.jit(lambda q, k, v: block_sparse_attention(q, k, v, lay, BLOCK))
+    out = f(q, k, v)
+    assert out.shape == q.shape
+    out2 = f(q, k, v)  # cache hit with hashable layout
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+# ------------------------------ module --------------------------------- #
+def test_sparse_self_attention_module():
+    model = SparseSelfAttention(
+        hidden_size=32, num_heads=2,
+        sparsity_config=FixedSparsityConfig(num_heads=2, block=BLOCK,
+                                            num_local_blocks=2))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 64, 32)),
+                    jnp.float32)
+    params = model.init(jax.random.key(0), x)
+    out = model.apply(params, x)
+    assert out.shape == (2, 64, 32)
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_sparse_attention_fn_key_padding_mask():
+    q, k, v = _qkv(B=1, S=64, H=2, D=8)
+    fn = SparseAttentionFn(DenseSparsityConfig(num_heads=2, block=BLOCK))
+    keep = np.ones((1, 64))
+    keep[0, 48:] = 0  # pad the tail
+    out = fn(q, k, v, key_padding_mask=jnp.asarray(keep))
+    # padded keys must not influence outputs: compare vs slicing them away
+    fn2 = SparseAttentionFn(DenseSparsityConfig(num_heads=2, block=BLOCK))
+    out_ref = fn2(q[:, :48], k[:, :48], v[:, :48])
+    np.testing.assert_allclose(np.asarray(out[:, :48]), np.asarray(out_ref),
+                               rtol=2e-3, atol=2e-4)
+
+
+def test_transformer_with_sparse_attention():
+    """End-to-end: a Transformer whose attention runs block-sparse (causal
+    sliding window) trains a step and stays close to the dense model."""
+    from deepspeed_tpu.models.transformer import (Transformer,
+                                                  TransformerConfig)
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+        max_seq_len=64, dtype="float32", use_flash_attention=False,
+        sparse_attention=LocalSlidingWindowSparsityConfig(
+            num_heads=2, block=BLOCK, num_sliding_window_blocks=4,
+            attention="unidirectional"),
+        remat=False, scan_layers=False)
+    model = Transformer(cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 64)),
+                      jnp.int32)
+    params = model.init(jax.random.key(0), {"input_ids": ids})
+    loss = model.apply(params, {"input_ids": ids})
+    assert np.isfinite(float(loss))
+    g = jax.grad(lambda p: model.apply(p, {"input_ids": ids}))(params)
+    assert all(bool(jnp.all(jnp.isfinite(x))) for x in jax.tree.leaves(g))

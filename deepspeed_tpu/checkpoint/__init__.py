@@ -1,0 +1,11 @@
+"""Offline checkpoint tools: inspection, universal (topology-free)
+conversion, TP shard surgery.  Reference: ``deepspeed/checkpoint/``."""
+
+from deepspeed_tpu.checkpoint.deepspeed_checkpoint import (  # noqa: F401
+    DeepSpeedCheckpoint, ZeROCheckpoint)
+from deepspeed_tpu.checkpoint.universal_checkpoint import (  # noqa: F401
+    convert_to_universal, load_hp_checkpoint_state, load_universal_meta,
+    load_universal_into_engine)
+from deepspeed_tpu.checkpoint.reshape_utils import (  # noqa: F401
+    merge_tp_shards, split_tp_shards, reshape_tp, reshape_flat_state_dict,
+    infer_tp_dim, partition_data)

@@ -1,0 +1,162 @@
+"""Offline checkpoint inspection and resharding.
+
+TPU-native counterpart of the reference's ``deepspeed/checkpoint/``
+(``deepspeed_checkpoint.py:33 DeepSpeedCheckpoint``,
+``zero_checkpoint.py:17 ZeROCheckpoint``): open a checkpoint directory
+written by ``DeepSpeedEngine.save_checkpoint`` *without* a live engine,
+enumerate tags/parameters/shapes, and lazily materialise arrays on host.
+
+Where the reference needs 3D-reshape machinery (``reshape_3d_utils.py``,
+``reshape_meg_2d.py``) because each rank wrote its own shard file, our
+checkpoints are a single logically-global Orbax array store — loading onto a
+different mesh/TP/DP degree is a property of *load-time shardings*, not of
+file surgery.  The file-surgery helpers that remain useful (importing or
+exporting foreign per-rank shard sets) live in ``reshape_utils.py``.
+"""
+
+import os
+import pickle
+import re
+
+import numpy as np
+
+import jax
+
+
+def _flatten_with_paths(tree):
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        from deepspeed_tpu.runtime.zero.partition import path_to_str
+        flat[path_to_str(path)] = leaf
+    return flat
+
+
+class DeepSpeedCheckpoint:
+    """View over one checkpoint directory (possibly many tags).
+
+    Reference parity: ``deepspeed/checkpoint/deepspeed_checkpoint.py:33``.
+    """
+
+    def __init__(self, ckpt_dir, tag=None):
+        self.ckpt_dir = ckpt_dir
+        if not os.path.isdir(ckpt_dir):
+            raise FileNotFoundError(f"no checkpoint directory at {ckpt_dir}")
+        self.tag = tag or self._latest_tag()
+        self.state_path = os.path.join(ckpt_dir, str(self.tag), "state")
+        if not os.path.isdir(self.state_path):
+            raise FileNotFoundError(f"tag {self.tag!r} has no state at "
+                                    f"{self.state_path}")
+        self._meta = None
+        self._arrays = None
+        self._flat_params = None
+
+    # ------------------------------------------------------------------ #
+    def _latest_tag(self):
+        latest = os.path.join(self.ckpt_dir, "latest")
+        if os.path.exists(latest):
+            with open(latest) as f:
+                return f.read().strip()
+        tags = self.get_tags()
+        if not tags:
+            raise FileNotFoundError(f"no tags under {self.ckpt_dir}")
+
+        # Natural sort so global_step10 beats global_step9.
+        def key(tag):
+            nums = re.findall(r"\d+", tag)
+            return (int(nums[-1]) if nums else -1, tag)
+        return max(tags, key=key)
+
+    def get_tags(self):
+        tags = []
+        for name in sorted(os.listdir(self.ckpt_dir)):
+            if os.path.isdir(os.path.join(self.ckpt_dir, name, "state")):
+                tags.append(name)
+        return tags
+
+    # ------------------------------------------------------------------ #
+    @property
+    def meta(self):
+        if self._meta is None:
+            with open(os.path.join(self.state_path, "meta.pkl"), "rb") as f:
+                self._meta = pickle.load(f)
+        return self._meta
+
+    @property
+    def global_steps(self):
+        return self.meta.get("global_steps", 0)
+
+    @property
+    def ds_config(self):
+        return self.meta.get("ds_config", {})
+
+    def _load_arrays(self):
+        if self._arrays is None:
+            from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+                OrbaxCheckpointEngine)
+            arrays, _ = OrbaxCheckpointEngine().load(self.state_path)
+            self._arrays = arrays or {}
+        return self._arrays
+
+    # ------------------------------------------------------------------ #
+    def module_state(self):
+        """The model parameter pytree (host arrays)."""
+        return jax.tree.map(np.asarray, self._load_arrays().get("module"))
+
+    def optimizer_state(self):
+        return self._load_arrays().get("optimizer")
+
+    def flat_parameters(self):
+        """{dotted-path: np.ndarray} over module parameters (cached)."""
+        if self._flat_params is None:
+            mod = self._load_arrays().get("module")
+            self._flat_params = {} if mod is None else {
+                k: np.asarray(v) for k, v in _flatten_with_paths(mod).items()}
+        return self._flat_params
+
+    def parameter_names(self):
+        return sorted(self.flat_parameters().keys())
+
+    def parameter_shapes(self):
+        return {k: tuple(v.shape) for k, v in self.flat_parameters().items()}
+
+    def num_parameters(self):
+        return int(sum(v.size for v in self.flat_parameters().values()))
+
+
+class ZeROCheckpoint(DeepSpeedCheckpoint):
+    """Optimizer-state-centric view (reference ``zero_checkpoint.py:17``).
+
+    Adds per-parameter access to the sharded optimizer moments, matched to
+    module parameters by tree congruence.
+    """
+
+    def flat_optimizer_moments(self):
+        """{field-name: {dotted-path: np.ndarray}} for optimizer-state fields
+        that are congruent to the parameter tree (e.g. adam mu/nu)."""
+        opt = self._load_arrays().get("optimizer")
+        mod = self._load_arrays().get("module")
+        if opt is None or mod is None:
+            return {}
+        params_def = jax.tree.structure(mod)
+        out = {}
+
+        def visit(field, name):
+            try:
+                if jax.tree.structure(field) == params_def:
+                    out[name] = {k: np.asarray(v) for k, v in
+                                 _flatten_with_paths(field).items()}
+                    return
+            except Exception:
+                pass
+            if hasattr(field, "_fields"):
+                for f in field._fields:
+                    visit(getattr(field, f), f"{name}.{f}" if name else f)
+            elif isinstance(field, (tuple, list)):
+                for i, f in enumerate(field):
+                    visit(f, f"{name}.{i}" if name else str(i))
+            elif isinstance(field, dict):
+                for k, f in field.items():
+                    visit(f, f"{name}.{k}" if name else str(k))
+
+        visit(opt, "")
+        return out

@@ -1,0 +1,102 @@
+"""File-level shard surgery for foreign (per-rank) checkpoint sets.
+
+Reference parity: ``deepspeed/checkpoint/reshape_utils.py`` +
+``reshape_meg_2d.py`` + ``reshape_3d_utils.py`` — merging and re-splitting
+Megatron-style tensor-parallel shard files when the target TP degree differs
+from the source.
+
+Our own checkpoints never need this (Orbax stores are logically global), but
+importing a TP-sharded external checkpoint — or exporting one for a
+torch-based consumer — does.  TP placement follows the same column/row rules
+the live framework uses (``runtime/zero/partition.py DEFAULT_TP_RULES``):
+column-parallel weights split on the output dim, row-parallel on the input
+dim.
+"""
+
+import re
+
+import numpy as np
+
+
+def partition_data(data, num_partitions):
+    """Split a list into contiguous near-equal chunks (reference
+    ``reshape_utils.py partition_data``)."""
+    parts = []
+    n = len(data)
+    base, rem = divmod(n, num_partitions)
+    start = 0
+    for i in range(num_partitions):
+        size = base + (1 if i < rem else 0)
+        parts.append(data[start:start + size])
+        start += size
+    return parts
+
+
+def merge_tp_shards(shards, dim):
+    """Concatenate per-TP-rank arrays back into the full tensor."""
+    if len(shards) == 1:
+        return np.asarray(shards[0])
+    return np.concatenate([np.asarray(s) for s in shards], axis=dim)
+
+
+def split_tp_shards(array, degree, dim):
+    """Split a full tensor into `degree` equal TP shards along `dim`."""
+    array = np.asarray(array)
+    if array.shape[dim] % degree != 0:
+        raise ValueError(f"dim {dim} of shape {array.shape} not divisible "
+                         f"by tp degree {degree}")
+    return [np.ascontiguousarray(s) for s in np.split(array, degree, axis=dim)]
+
+
+def reshape_tp(shards, target_degree, dim):
+    """source-degree shards → target-degree shards along the same dim."""
+    full = merge_tp_shards(shards, dim)
+    return split_tp_shards(full, target_degree, dim)
+
+
+# --------------------------------------------------------------------- #
+# TP-dim classification by parameter name — DELEGATES to the live sharding
+# rules (``runtime/zero/partition.py DEFAULT_TP_RULES``) so offline surgery
+# and runtime placement agree by construction.
+# --------------------------------------------------------------------- #
+def infer_tp_dim(param_name, ndim, rules=None):
+    """Which dim a parameter splits on for TP, or None if replicated.
+
+    Dims are for the framework's native flax layouts: column-parallel →
+    last dim, row-parallel → second-to-last (covers both 2-D ``Dense`` and
+    3-D ``DenseGeneral`` kernels), embeddings → vocab dim 0.
+    """
+    if ndim < 2:
+        return None
+    from deepspeed_tpu.runtime.zero.partition import DEFAULT_TP_RULES
+    rules = rules if rules is not None else DEFAULT_TP_RULES
+    low = param_name.lower()
+    for pattern, kind in rules:
+        if re.search(pattern, low):
+            dim = {"col": ndim - 1, "row": ndim - 2, "vocab": 0}.get(kind)
+            return dim if dim is not None and dim >= 0 else None
+    return None
+
+
+def reshape_flat_state_dict(flat, source_degree, target_degree):
+    """Reshape a {name: [shard_0..shard_{src-1}]} dict of TP shard lists into
+    target-degree shard lists, keyed by the same names."""
+    out = {}
+    for name, shards in flat.items():
+        if len(shards) != source_degree:
+            raise ValueError(f"{name}: expected {source_degree} shards, got "
+                             f"{len(shards)}")
+        ndim = np.asarray(shards[0]).ndim
+        dim = infer_tp_dim(name, ndim)
+        if dim is None:
+            # Unclassified ⇒ must genuinely be replicated; a sharded param
+            # that slipped past the name rules would otherwise lose data.
+            for i, s in enumerate(shards[1:], start=1):
+                if not np.array_equal(np.asarray(s), np.asarray(shards[0])):
+                    raise ValueError(
+                        f"{name}: shards 0 and {i} differ but no TP rule "
+                        f"classifies this parameter; pass explicit rules")
+            out[name] = [np.asarray(shards[0])] * target_degree
+        else:
+            out[name] = reshape_tp(shards, target_degree, dim)
+    return out

@@ -1,0 +1,61 @@
+"""Offline merge of a training checkpoint into a single fp32 state dict.
+
+Reference parity: ``deepspeed/utils/zero_to_fp32.py``
+(``get_fp32_state_dict_from_zero_checkpoint :459``,
+``convert_zero_checkpoint_to_fp32_state_dict :508``) — runs on CPU without
+instantiating the model.  Because our checkpoints are logically-global Orbax
+stores, "merging ZeRO shards" is simply a host restore + fp32 cast; the
+output is written with ``torch.save`` when torch is importable (the usual
+consumer is a torch pipeline) and pickle otherwise.
+
+Usage:  python -m deepspeed_tpu.utils.zero_to_fp32 <ckpt_dir> <output_file>
+"""
+
+import argparse
+import pickle
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None):
+    """{dotted-param-path: np.float32 array} for all module parameters."""
+    from deepspeed_tpu.checkpoint.deepspeed_checkpoint import DeepSpeedCheckpoint
+    ckpt = DeepSpeedCheckpoint(checkpoint_dir, tag=tag)
+    out = {}
+    for name, arr in ckpt.flat_parameters().items():
+        out[name] = arr.astype(np.float32) \
+            if np.issubdtype(arr.dtype, np.floating) else arr
+    return out
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir, output_file,
+                                               tag=None):
+    state_dict = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    try:
+        import torch
+        torch_sd = {k: torch.from_numpy(np.ascontiguousarray(v))
+                    for k, v in state_dict.items()}
+        torch.save(torch_sd, output_file)
+    except ImportError:
+        with open(output_file, "wb") as f:
+            pickle.dump(state_dict, f)
+    logger.info(f"saved fp32 state dict ({len(state_dict)} tensors) to "
+                f"{output_file}")
+    return output_file
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Merge a deepspeed_tpu checkpoint to one fp32 state dict")
+    parser.add_argument("checkpoint_dir")
+    parser.add_argument("output_file")
+    parser.add_argument("-t", "--tag", default=None)
+    args = parser.parse_args()
+    convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir,
+                                               args.output_file, tag=args.tag)
+
+
+if __name__ == "__main__":
+    main()

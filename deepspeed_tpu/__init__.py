@@ -79,14 +79,34 @@ def initialize(args=None,
 
 
 def init_inference(model=None, config=None, **kwargs):
-    """Initialize the inference engine (reference ``__init__.py:260``)."""
+    """Initialize the inference engine (reference ``__init__.py:260``).
+
+    ``model`` may be a flax Module, an HF torch model, or an HF model
+    name/path — torch models are converted through the injection policies
+    (``module_inject/``), the analog of the reference's kernel injection."""
     from deepspeed_tpu.inference.engine import InferenceEngine
     from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
     if isinstance(config, dict):
         config = DeepSpeedInferenceConfig(**config, **kwargs)
     elif config is None:
         config = DeepSpeedInferenceConfig(**kwargs)
-    return InferenceEngine(model, config)
+
+    params = None
+    is_torch = False
+    if isinstance(model, str):
+        is_torch = True
+    else:
+        try:
+            import torch
+            is_torch = isinstance(model, torch.nn.Module)
+        except ImportError:
+            pass
+    if is_torch:
+        from deepspeed_tpu.module_inject import convert_hf_model
+        from deepspeed_tpu.inference.config import normalize_dtype_str
+        model, params = convert_hf_model(
+            model, dtype=normalize_dtype_str(config.dtype))
+    return InferenceEngine(model, config, params=params)
 
 
 def add_config_arguments(parser):

@@ -78,16 +78,18 @@ def infer_tp_dim(param_name, ndim, rules=None):
     return None
 
 
-def reshape_flat_state_dict(flat, source_degree, target_degree):
+def reshape_flat_state_dict(flat, source_degree, target_degree, rules=None):
     """Reshape a {name: [shard_0..shard_{src-1}]} dict of TP shard lists into
-    target-degree shard lists, keyed by the same names."""
+    target-degree shard lists, keyed by the same names.  ``rules`` overrides
+    the default name-classification rules (same format as
+    ``DEFAULT_TP_RULES``) for foreign naming schemes."""
     out = {}
     for name, shards in flat.items():
         if len(shards) != source_degree:
             raise ValueError(f"{name}: expected {source_degree} shards, got "
                              f"{len(shards)}")
         ndim = np.asarray(shards[0]).ndim
-        dim = infer_tp_dim(name, ndim)
+        dim = infer_tp_dim(name, ndim, rules=rules)
         if dim is None:
             # Unclassified ⇒ must genuinely be replicated; a sharded param
             # that slipped past the name rules would otherwise lose data.
@@ -95,7 +97,8 @@ def reshape_flat_state_dict(flat, source_degree, target_degree):
                 if not np.array_equal(np.asarray(s), np.asarray(shards[0])):
                     raise ValueError(
                         f"{name}: shards 0 and {i} differ but no TP rule "
-                        f"classifies this parameter; pass explicit rules")
+                        f"classifies this parameter; pass rules= with a "
+                        f"pattern for it")
             out[name] = [np.asarray(shards[0])] * target_degree
         else:
             out[name] = reshape_tp(shards, target_degree, dim)

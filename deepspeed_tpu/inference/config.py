@@ -9,6 +9,21 @@ from pydantic import Field
 
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 
+# Canonical dtype-string spellings ("torch.float16", "fp16", "half", ... →
+# "float16"); shared by init_inference's conversion and the engine's cast.
+_DTYPE_ALIASES = {"float16": "float16", "fp16": "float16", "half": "float16",
+                  "bfloat16": "bfloat16", "bf16": "bfloat16",
+                  "float32": "float32", "fp32": "float32",
+                  "float": "float32"}
+
+
+def normalize_dtype_str(dtype) -> str:
+    key = str(dtype).replace("torch.", "")
+    if key not in _DTYPE_ALIASES:
+        raise ValueError(f"unsupported dtype {dtype!r}; one of "
+                         f"{sorted(set(_DTYPE_ALIASES))}")
+    return _DTYPE_ALIASES[key]
+
 
 class DeepSpeedTPConfig(DeepSpeedConfigModel):
     enabled: bool = True

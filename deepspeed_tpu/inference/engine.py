@@ -45,11 +45,11 @@ class InferenceEngine:
         tp = self._config.tensor_parallel.tp_size
         self.topology = topo_mod.initialize_topology(tp=tp, ep=self._config.ep_size)
         self.mesh = self.topology.mesh
-        self.compute_dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
-                              "float32": jnp.float32, "fp16": jnp.float16,
-                              "bf16": jnp.bfloat16, "fp32": jnp.float32,
-                              "float": jnp.float32, "half": jnp.float16}[
-                                  str(self._config.dtype).replace("torch.", "")]
+        from deepspeed_tpu.inference.config import normalize_dtype_str
+        self.compute_dtype = {"bfloat16": jnp.bfloat16,
+                              "float16": jnp.float16,
+                              "float32": jnp.float32}[
+                                  normalize_dtype_str(self._config.dtype)]
         self._params = None
         self._compiled = {}
         self._rng = jax.random.key(0)
@@ -182,7 +182,9 @@ class InferenceEngine:
             (_, _, _, _, _), toks = jax.lax.scan(
                 step, (next_tok, cache, jnp.asarray(prompt_len), rng, done0),
                 None, length=max_new_tokens - 1)
-            return jnp.concatenate([next_tok[:, None], toks.T], axis=1)
+            # HF contract: prompt + generated tokens
+            return jnp.concatenate([input_ids, next_tok[:, None], toks.T],
+                                   axis=1)
 
         self._compiled[key] = jax.jit(generate)
         return self._compiled[key]
@@ -190,8 +192,9 @@ class InferenceEngine:
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=-1, seed=None,
                  attention_mask=None):
-        """Autoregressive generation: returns [B, max_new_tokens] new tokens
-        (reference ``engine._generate :614``; HF-style args).
+        """Autoregressive generation: returns [B, prompt_len+max_new_tokens]
+        — prompt followed by new tokens, the HF ``generate`` contract
+        (reference ``engine._generate :614``).
 
         Prompts must be unpadded (equal length per batch row) — the cached
         decode path has no padding mask yet.

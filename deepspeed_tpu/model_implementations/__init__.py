@@ -1,0 +1,4 @@
+"""Injected model implementations (reference ``model_implementations/``)."""
+
+from deepspeed_tpu.model_implementations.transformers.ds_transformer import (  # noqa: F401
+    DeepSpeedTransformerInference)

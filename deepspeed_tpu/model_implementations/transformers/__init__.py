@@ -1,0 +1,2 @@
+from deepspeed_tpu.model_implementations.transformers.ds_transformer import (  # noqa: F401
+    DeepSpeedTransformerInference)

@@ -1,0 +1,60 @@
+"""DeepSpeedTransformerInference — the stateful decode wrapper.
+
+Reference parity: ``model_implementations/transformers/ds_transformer.py:19``
+(the module the reference injects per layer, holding fused kernels + the KV
+workspace).  TPU-native version: holds the whole converted flax
+``Transformer`` plus its KV cache, exposing a torch-like stateful
+``forward`` for incremental decoding.  The per-step program is one jitted
+XLA computation with the cache donated, so repeated calls replay a compiled
+executable — the analog of the reference's CUDA-graph path.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.transformer import Transformer, TransformerConfig
+
+
+class DeepSpeedTransformerInference:
+
+    def __init__(self, config: TransformerConfig, params=None, max_batch=1,
+                 max_seq_len=None):
+        self.config = config
+        self.module = Transformer(config)
+        self.params = params
+        self.max_batch = max_batch
+        self.max_seq_len = max_seq_len or config.max_seq_len
+        self._cache = None
+        self._pos = 0
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def _step(params, ids, cache, start_pos):
+            return self.module.apply(params, ids, cache, start_pos,
+                                     method=Transformer.decode)
+        self._step = _step
+
+    def reset_cache(self, batch_size=None):
+        self._cache = self.module.init_cache(batch_size or self.max_batch,
+                                             self.max_seq_len)
+        self._pos = 0
+
+    def forward(self, input_ids):
+        """Incremental forward: feed the prompt once, then one token at a
+        time; returns logits for the fed positions.  Raises on cache
+        overflow — call ``reset_cache`` to start a new sequence."""
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        if self._cache is None:
+            self.reset_cache(input_ids.shape[0])
+        if self._pos + input_ids.shape[1] > self.max_seq_len:
+            raise ValueError(
+                f"KV cache overflow: {self._pos} + {input_ids.shape[1]} "
+                f"tokens > max_seq_len={self.max_seq_len}; reset_cache() to "
+                f"start a new sequence")
+        logits, self._cache = self._step(self.params, input_ids, self._cache,
+                                         jnp.int32(self._pos))
+        self._pos += input_ids.shape[1]
+        return logits
+
+    __call__ = forward

@@ -39,12 +39,20 @@ class TransformerConfig:
     num_kv_heads: Optional[int] = None       # GQA; None → MHA
     ffn_hidden_size: Optional[int] = None    # None → 4*hidden
     max_seq_len: int = 2048
-    activation: str = "relu"                 # relu (OPT) | gelu (GPT) | silu (llama gated)
+    activation: str = "relu"                 # relu (OPT) | gelu tanh (GPT2) | gelu_exact (neox) | silu (llama gated)
     gated_mlp: bool = False                  # llama-style SwiGLU
-    position_embedding: str = "learned"      # learned (OPT/GPT) | rope (llama/neox)
+    position_embedding: str = "learned"      # learned (OPT/GPT) | rope (llama/neox) | alibi (bloom)
     rope_theta: float = 10000.0
+    rope_dim: Optional[int] = None           # partial rotary (neox rotary_pct / gptj rotary_dim)
+    rope_interleaved: bool = False           # gptj rotate-every-two layout
     layernorm_epsilon: float = 1e-5
     rms_norm: bool = False                   # llama
+    parallel_residual: bool = False          # x + attn(ln(x)) + mlp(ln'(x)) (neox/gptj)
+    shared_attn_mlp_norm: bool = False       # gptj: one ln feeds both branches
+    embedding_norm: bool = False             # bloom word_embeddings_layernorm
+    attention_bias: Optional[bool] = None    # None → not rms_norm
+    mlp_bias: Optional[bool] = None          # None → not rms_norm
+    lm_head_bias: bool = False               # gptj
     dropout: float = 0.0
     tie_word_embeddings: bool = False
     dtype: str = "bfloat16"
@@ -67,6 +75,15 @@ class TransformerConfig:
         return self.ffn_hidden_size or 4 * self.hidden_size
 
     @property
+    def attn_bias_enabled(self):
+        return self.attention_bias if self.attention_bias is not None \
+            else not self.rms_norm
+
+    @property
+    def mlp_bias_enabled(self):
+        return self.mlp_bias if self.mlp_bias is not None else not self.rms_norm
+
+    @property
     def jnp_dtype(self):
         return {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
                 "float16": jnp.float16}[self.dtype]
@@ -78,11 +95,14 @@ class TransformerConfig:
         kvh = self.kv_heads * self.head_dim
         attn = h * h + h * kvh * 2 + h * h  # q, k, v, o kernels
         mlp = h * f * (3 if self.gated_mlp else 2)
-        norms = 2 * h * (1 if self.rms_norm else 2)
-        per_layer = attn + mlp + norms
-        emb = v * h + (0 if self.position_embedding == "rope" else self.max_seq_len * h)
+        norm_size = h if self.rms_norm else 2 * h
+        norms_per_layer = 1 if (self.parallel_residual
+                                and self.shared_attn_mlp_norm) else 2
+        per_layer = attn + mlp + norms_per_layer * norm_size
+        emb = v * h + (self.max_seq_len * h
+                       if self.position_embedding == "learned" else 0)
         head = 0 if self.tie_word_embeddings else v * h
-        return emb + l * per_layer + (h if self.rms_norm else 2 * h) + head
+        return emb + l * per_layer + norm_size + head
 
 
 def _norm(config, name):
@@ -93,25 +113,62 @@ def _norm(config, name):
                         param_dtype=jnp.float32)
 
 
-def _rope(q, k, positions, head_dim, theta):
-    """Rotary position embeddings (neox/llama style, non-interleaved)."""
-    half = head_dim // 2
+def _rope(q, k, positions, head_dim, theta, rope_dim=None, interleaved=False):
+    """Rotary position embeddings.  Default: neox/llama half-split layout;
+    ``interleaved`` selects the gptj rotate-every-two layout; ``rope_dim``
+    rotates only the first ``rope_dim`` features (neox ``rotary_pct`` /
+    gptj ``rotary_dim``)."""
+    d = rope_dim or head_dim
+    half = d // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,half]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
 
     def rot(x):
-        x1, x2 = x[..., :half], x[..., half:]
-        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        rx, pass_through = x[..., :d], x[..., d:]
+        if interleaved:
+            x1, x2 = rx[..., 0::2], rx[..., 1::2]
+            r1 = x1 * cos - x2 * sin
+            r2 = x2 * cos + x1 * sin
+            out = jnp.stack([r1, r2], axis=-1).reshape(rx.shape)
+        else:
+            x1, x2 = rx[..., :half], rx[..., half:]
+            out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                                  axis=-1)
+        if pass_through.shape[-1]:
+            out = jnp.concatenate([out, pass_through], axis=-1)
         return out.astype(x.dtype)
 
     return rot(q), rot(k)
 
 
-def reference_attention(q, k, v, causal=True, mask=None):
+def alibi_slopes(n_heads):
+    """ALiBi per-head slopes (bloom layout)."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(np.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if np.log2(n_heads).is_integer():
+        slopes = pow2_slopes(n_heads)
+    else:
+        p = 2 ** int(np.floor(np.log2(n_heads)))
+        slopes = pow2_slopes(p) + pow2_slopes(2 * p)[0::2][: n_heads - p]
+    return jnp.asarray(slopes, dtype=jnp.float32)
+
+
+def alibi_bias(n_heads, kv_len):
+    """[H, T] key-positional ALiBi bias.  The relative form
+    ``slope·(t - s)`` differs from this per query row only by a constant,
+    which softmax cancels — so the key-absolute form is exact for causal
+    attention (what bloom itself implements)."""
+    return alibi_slopes(n_heads)[:, None] * jnp.arange(kv_len)[None, :]
+
+
+def reference_attention(q, k, v, causal=True, mask=None, bias=None):
     """jnp attention used as the CPU fallback and the golden reference for
-    the Pallas kernel tests.  q,k,v: [B, S, H, D] / [B, S, KVH, D]."""
+    the Pallas kernel tests.  q,k,v: [B, S, H, D] / [B, S, KVH, D];
+    ``bias``: optional [H, T] additive logit bias (ALiBi)."""
     B, S, H, D = q.shape
     KVH = k.shape[2]
     if KVH != H:
@@ -120,6 +177,8 @@ def reference_attention(q, k, v, causal=True, mask=None):
         v = jnp.repeat(v, rep, axis=2)
     scale = 1.0 / np.sqrt(D)
     logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias[None, :, None, :].astype(jnp.float32)
     if causal:
         causal_mask = jnp.tril(jnp.ones((S, k.shape[1]), dtype=bool))
         logits = jnp.where(causal_mask[None, None], logits, -1e30)
@@ -129,8 +188,8 @@ def reference_attention(q, k, v, causal=True, mask=None):
     return jnp.einsum("bhst,bthd->bshd", probs, v)
 
 
-def _attention(q, k, v, config, mask=None):
-    if config.sparse_attention is not None and q.shape[1] > 1:
+def _attention(q, k, v, config, mask=None, bias=None):
+    if config.sparse_attention is not None and q.shape[1] > 1 and bias is None:
         from deepspeed_tpu.ops.sparse_attention.block_sparse import (
             block_sparse_attention, cached_layout)
         sc = config.sparse_attention
@@ -145,15 +204,16 @@ def _attention(q, k, v, config, mask=None):
                 v = jnp.repeat(v, q.shape[2] // v.shape[2], axis=2)
             return block_sparse_attention(q, k, v, layout, sc.block,
                                           causal=True, key_padding_mask=mask)
-    if config.use_flash_attention and q.shape[1] > 1 and mask is None:
+    if config.use_flash_attention and q.shape[1] > 1 and mask is None \
+            and bias is None:
         from deepspeed_tpu.ops.transformer.flash_attention import (
             flash_attention, pallas_supported)
         if pallas_supported():
             return flash_attention(q, k, v, causal=True)
-    return reference_attention(q, k, v, causal=True, mask=mask)
+    return reference_attention(q, k, v, causal=True, mask=mask, bias=bias)
 
 
-def cached_attention(q, k_cache, v_cache, q_positions):
+def cached_attention(q, k_cache, v_cache, q_positions, bias=None):
     """Decode attention against a KV cache.
 
     q: [B, S, H, D]; caches: [B, S_max, KVH, D]; q_positions: [B, S]
@@ -170,6 +230,8 @@ def cached_attention(q, k_cache, v_cache, q_positions):
         v_cache = jnp.repeat(v_cache, rep, axis=2)
     scale = 1.0 / np.sqrt(D)
     logits = jnp.einsum("bshd,bthd->bhst", q, k_cache).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias[None, :, None, :].astype(jnp.float32)
     kv_pos = jnp.arange(S_max)
     ok = q_positions[:, None, :, None] >= kv_pos[None, None, None, :]
     logits = jnp.where(ok, logits, -1e30)
@@ -184,13 +246,18 @@ class Attention(nn.Module):
     def __call__(self, x, positions, mask=None, cache=None):
         cfg = self.config
         D, H, KVH = cfg.head_dim, cfg.num_heads, cfg.kv_heads
-        dense = partial(nn.DenseGeneral, use_bias=not cfg.rms_norm,
+        dense = partial(nn.DenseGeneral, use_bias=cfg.attn_bias_enabled,
                         dtype=cfg.jnp_dtype, param_dtype=jnp.float32)
         q = dense(features=(H, D), name="q_proj")(x)
         k = dense(features=(KVH, D), name="k_proj")(x)
         v = dense(features=(KVH, D), name="v_proj")(x)
         if cfg.position_embedding == "rope":
-            q, k = _rope(q, k, positions, D, cfg.rope_theta)
+            q, k = _rope(q, k, positions, D, cfg.rope_theta,
+                         rope_dim=cfg.rope_dim,
+                         interleaved=cfg.rope_interleaved)
+        bias = alibi_bias(H, cache["k"].shape[1] if cache is not None
+                          else x.shape[1]) \
+            if cfg.position_embedding == "alibi" else None
         if cache is not None:
             if cfg.sparse_attention is not None:
                 # KV-cache decode attends densely over the cache; a
@@ -206,10 +273,10 @@ class Attention(nn.Module):
                 cache["k"], k.astype(cache["k"].dtype), (0, start, 0, 0))
             v_cache = jax.lax.dynamic_update_slice(
                 cache["v"], v.astype(cache["v"].dtype), (0, start, 0, 0))
-            out = cached_attention(q, k_cache, v_cache, positions)
+            out = cached_attention(q, k_cache, v_cache, positions, bias=bias)
             new_cache = {"k": k_cache, "v": v_cache}
         else:
-            out = _attention(q, k, v, cfg, mask=mask)
+            out = _attention(q, k, v, cfg, mask=mask, bias=bias)
             new_cache = None
         proj = dense(features=cfg.hidden_size, axis=(-2, -1), name="o_proj")(
             out.reshape(*out.shape[:2], H, D))
@@ -222,9 +289,11 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        dense = partial(nn.Dense, use_bias=not cfg.rms_norm,
+        dense = partial(nn.Dense, use_bias=cfg.mlp_bias_enabled,
                         dtype=cfg.jnp_dtype, param_dtype=jnp.float32)
-        act = {"relu": nn.relu, "gelu": nn.gelu, "silu": nn.silu}[cfg.activation]
+        act = {"relu": nn.relu, "gelu": nn.gelu,
+               "gelu_exact": partial(nn.gelu, approximate=False),
+               "silu": nn.silu}[cfg.activation]
         if cfg.gated_mlp:
             gate = dense(cfg.ffn_size, name="gate_proj")(x)
             up = dense(cfg.ffn_size, name="up_proj")(x)
@@ -240,12 +309,17 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, positions, mask=None, cache=None):
         cfg = self.config
-        attn, new_cache = Attention(cfg, name="attn")(
-            _norm(cfg, "input_norm")(x).astype(cfg.jnp_dtype), positions, mask,
-            cache)
-        x = x + attn
-        x = x + MLP(cfg, name="mlp")(
-            _norm(cfg, "post_attn_norm")(x).astype(cfg.jnp_dtype))
+        normed = _norm(cfg, "input_norm")(x).astype(cfg.jnp_dtype)
+        attn, new_cache = Attention(cfg, name="attn")(normed, positions, mask,
+                                                      cache)
+        if cfg.parallel_residual:
+            mlp_in = normed if cfg.shared_attn_mlp_norm else \
+                _norm(cfg, "post_attn_norm")(x).astype(cfg.jnp_dtype)
+            x = x + attn + MLP(cfg, name="mlp")(mlp_in)
+        else:
+            x = x + attn
+            x = x + MLP(cfg, name="mlp")(
+                _norm(cfg, "post_attn_norm")(x).astype(cfg.jnp_dtype))
         return x, new_cache
 
 
@@ -271,6 +345,8 @@ class Transformer(nn.Module):
             self.embed_positions = nn.Embed(cfg.max_seq_len, cfg.hidden_size,
                                             param_dtype=jnp.float32,
                                             name="embed_positions")
+        if cfg.embedding_norm:
+            self.embed_norm = _norm(cfg, "embed_norm")
         block = ScanBlock if cfg.scan_layers else Block
         if cfg.remat:
             policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
@@ -289,7 +365,7 @@ class Transformer(nn.Module):
                                for i in range(cfg.num_layers)]
         self.final_norm = _norm(cfg, "final_norm")
         if not cfg.tie_word_embeddings:
-            self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False,
+            self.lm_head = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias,
                                     dtype=cfg.jnp_dtype, param_dtype=jnp.float32,
                                     name="lm_head")
 
@@ -300,6 +376,8 @@ class Transformer(nn.Module):
         x = self.embed_tokens(input_ids).astype(cfg.jnp_dtype)
         if cfg.position_embedding == "learned":
             x = x + self.embed_positions(positions).astype(cfg.jnp_dtype)
+        if cfg.embedding_norm:
+            x = self.embed_norm(x).astype(cfg.jnp_dtype)
         if cfg.scan_layers:
             x, new_cache = self.blocks(x, positions, mask, cache)
         else:

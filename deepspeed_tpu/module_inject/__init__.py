@@ -1,0 +1,14 @@
+"""Kernel-injection / model-conversion layer (reference ``module_inject/``).
+
+On TPU "injection" = convert the HF torch checkpoint onto the framework's
+flax Transformer and let XLA compile the fused program; TP slicing =
+sharding annotations (AutoTP rules) instead of per-rank weight surgery.
+"""
+
+from deepspeed_tpu.module_inject.replace_module import (  # noqa: F401
+    convert_hf_model, replace_transformer_layer, policy_for)
+from deepspeed_tpu.module_inject.auto_tp import AutoTP, get_tp_rules  # noqa: F401
+from deepspeed_tpu.module_inject.policy import HFPolicy  # noqa: F401
+from deepspeed_tpu.module_inject.containers import (  # noqa: F401
+    OPTPolicy, GPT2Policy, LlamaPolicy, BloomPolicy, GPTNeoXPolicy,
+    GPTJPolicy, ALL_POLICIES)

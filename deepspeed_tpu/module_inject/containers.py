@@ -1,0 +1,309 @@
+"""Per-architecture injection policies.
+
+Counterpart of reference ``module_inject/containers/{opt,gpt2,gptneox,gptj,
+bloom,llama,...}.py`` — one policy class per HF decoder family, each encoding
+(a) the architecture knobs (``build_config``) and (b) the checkpoint layout
+(``layer_params``/``top_params``).
+"""
+
+import numpy as np
+
+from deepspeed_tpu.models.transformer import TransformerConfig
+from deepspeed_tpu.module_inject.policy import (
+    ACT_MAP, HFPolicy, _np, linear_kernel, o_kernel, qkv_bias, qkv_kernel,
+    split_fused_qkv_columns, split_fused_qkv_headwise)
+
+
+class OPTPolicy(HFPolicy):
+    """facebook/opt-* (reference ``containers/opt.py``)."""
+
+    model_types = ("opt",)
+
+    def build_config(self, hf, **over):
+        if getattr(hf, "word_embed_proj_dim", hf.hidden_size) != hf.hidden_size:
+            raise NotImplementedError("OPT word_embed_proj_dim != hidden_size "
+                                      "(opt-350m) is not supported")
+        if not getattr(hf, "do_layer_norm_before", True):
+            raise NotImplementedError("OPT post-LN variant not supported")
+        base = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            num_layers=hf.num_hidden_layers,
+            num_heads=hf.num_attention_heads,
+            ffn_hidden_size=hf.ffn_dim,
+            max_seq_len=hf.max_position_embeddings,
+            activation=ACT_MAP[hf.activation_function],
+            position_embedding="learned",
+            tie_word_embeddings=hf.tie_word_embeddings,
+        )
+        base.update(over)
+        return TransformerConfig(**base)
+
+    def top_params(self, sd, cfg):
+        out = {"embed_tokens/embedding": _np(sd["model.decoder.embed_tokens.weight"]),
+               # OPTLearnedPositionalEmbedding carries a +2 offset; drop the
+               # two offset rows so plain arange positions index correctly.
+               "embed_positions/embedding":
+                   _np(sd["model.decoder.embed_positions.weight"])[2:]}
+        out.update(self.norm(sd, "model.decoder.final_layer_norm", "final_norm"))
+        if not cfg.tie_word_embeddings:
+            out["lm_head/kernel"] = linear_kernel(sd["lm_head.weight"])
+        return out
+
+    def layer_params(self, sd, i, cfg):
+        p = f"model.decoder.layers.{i}"
+        out = self.attn_separate(sd, f"{p}.self_attn", cfg)
+        out.update(self.norm(sd, f"{p}.self_attn_layer_norm", "input_norm"))
+        # OPT's per-layer "final_layer_norm" is the pre-MLP norm
+        out.update(self.norm(sd, f"{p}.final_layer_norm", "post_attn_norm"))
+        out["mlp/up_proj/kernel"] = linear_kernel(sd[f"{p}.fc1.weight"])
+        out["mlp/up_proj/bias"] = _np(sd[f"{p}.fc1.bias"])
+        out["mlp/down_proj/kernel"] = linear_kernel(sd[f"{p}.fc2.weight"])
+        out["mlp/down_proj/bias"] = _np(sd[f"{p}.fc2.bias"])
+        return out
+
+
+class GPT2Policy(HFPolicy):
+    """gpt2* (reference ``containers/gpt2.py`` / megatron containers).
+    GPT2 uses Conv1D ([in, out]) weights — no transpose needed."""
+
+    model_types = ("gpt2",)
+
+    def build_config(self, hf, **over):
+        base = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.n_embd,
+            num_layers=hf.n_layer,
+            num_heads=hf.n_head,
+            ffn_hidden_size=(hf.n_inner or 4 * hf.n_embd),
+            max_seq_len=hf.n_positions,
+            activation=ACT_MAP[hf.activation_function],
+            position_embedding="learned",
+            tie_word_embeddings=True,
+        )
+        base.update(over)
+        return TransformerConfig(**base)
+
+    def top_params(self, sd, cfg):
+        out = {"embed_tokens/embedding": _np(sd["transformer.wte.weight"]),
+               "embed_positions/embedding": _np(sd["transformer.wpe.weight"])}
+        out.update(self.norm(sd, "transformer.ln_f", "final_norm"))
+        return out
+
+    def layer_params(self, sd, i, cfg):
+        p = f"transformer.h.{i}"
+        H, D = cfg.num_heads, cfg.head_dim
+        out = split_fused_qkv_columns(_np(sd[f"{p}.attn.c_attn.weight"]), H, D,
+                                      bias=_np(sd[f"{p}.attn.c_attn.bias"]))
+        # c_proj is Conv1D [in=H*D, out=h]: already [in, out]
+        out["attn/o_proj/kernel"] = np.ascontiguousarray(
+            _np(sd[f"{p}.attn.c_proj.weight"]).reshape(H, D, -1))
+        out["attn/o_proj/bias"] = _np(sd[f"{p}.attn.c_proj.bias"])
+        out.update(self.norm(sd, f"{p}.ln_1", "input_norm"))
+        out.update(self.norm(sd, f"{p}.ln_2", "post_attn_norm"))
+        out["mlp/up_proj/kernel"] = _np(sd[f"{p}.mlp.c_fc.weight"])
+        out["mlp/up_proj/bias"] = _np(sd[f"{p}.mlp.c_fc.bias"])
+        out["mlp/down_proj/kernel"] = _np(sd[f"{p}.mlp.c_proj.weight"])
+        out["mlp/down_proj/bias"] = _np(sd[f"{p}.mlp.c_proj.bias"])
+        return out
+
+
+class LlamaPolicy(HFPolicy):
+    """llama/mistral family (reference ``containers/llama.py``)."""
+
+    model_types = ("llama", "mistral")
+
+    def build_config(self, hf, **over):
+        base = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            num_layers=hf.num_hidden_layers,
+            num_heads=hf.num_attention_heads,
+            num_kv_heads=getattr(hf, "num_key_value_heads",
+                                 hf.num_attention_heads),
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            activation=ACT_MAP[hf.hidden_act],
+            gated_mlp=True,
+            position_embedding="rope",
+            rope_theta=getattr(hf, "rope_theta", 10000.0),
+            rms_norm=True,
+            layernorm_epsilon=hf.rms_norm_eps,
+            tie_word_embeddings=getattr(hf, "tie_word_embeddings", False),
+        )
+        base.update(over)
+        return TransformerConfig(**base)
+
+    def top_params(self, sd, cfg):
+        out = {"embed_tokens/embedding": _np(sd["model.embed_tokens.weight"])}
+        out.update(self.norm(sd, "model.norm", "final_norm", rms=True))
+        if not cfg.tie_word_embeddings:
+            out["lm_head/kernel"] = linear_kernel(sd["lm_head.weight"])
+        return out
+
+    def layer_params(self, sd, i, cfg):
+        p = f"model.layers.{i}"
+        out = self.attn_separate(sd, f"{p}.self_attn", cfg, out_name="o_proj")
+        out.update(self.norm(sd, f"{p}.input_layernorm", "input_norm", rms=True))
+        out.update(self.norm(sd, f"{p}.post_attention_layernorm",
+                             "post_attn_norm", rms=True))
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            out[f"mlp/{name}/kernel"] = linear_kernel(sd[f"{p}.mlp.{name}.weight"])
+        return out
+
+
+class BloomPolicy(HFPolicy):
+    """bigscience/bloom* (reference ``containers/bloom.py``): ALiBi
+    positions, embedding layernorm, head-interleaved fused QKV."""
+
+    model_types = ("bloom",)
+
+    def build_config(self, hf, **over):
+        base = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            num_layers=hf.n_layer,
+            num_heads=hf.n_head,
+            ffn_hidden_size=4 * hf.hidden_size,
+            max_seq_len=2048,
+            activation="gelu",           # BloomGelu is the tanh approximation
+            position_embedding="alibi",
+            embedding_norm=True,
+            layernorm_epsilon=hf.layer_norm_epsilon,
+            tie_word_embeddings=True,
+        )
+        base.update(over)
+        return TransformerConfig(**base)
+
+    def top_params(self, sd, cfg):
+        out = {"embed_tokens/embedding": _np(sd["transformer.word_embeddings.weight"])}
+        out.update(self.norm(sd, "transformer.word_embeddings_layernorm",
+                             "embed_norm"))
+        out.update(self.norm(sd, "transformer.ln_f", "final_norm"))
+        return out
+
+    def layer_params(self, sd, i, cfg):
+        p = f"transformer.h.{i}"
+        H, D = cfg.num_heads, cfg.head_dim
+        out = split_fused_qkv_headwise(
+            sd[f"{p}.self_attention.query_key_value.weight"], H, D,
+            bias=sd[f"{p}.self_attention.query_key_value.bias"])
+        out["attn/o_proj/kernel"] = o_kernel(
+            sd[f"{p}.self_attention.dense.weight"], H, D)
+        out["attn/o_proj/bias"] = _np(sd[f"{p}.self_attention.dense.bias"])
+        out.update(self.norm(sd, f"{p}.input_layernorm", "input_norm"))
+        out.update(self.norm(sd, f"{p}.post_attention_layernorm",
+                             "post_attn_norm"))
+        out["mlp/up_proj/kernel"] = linear_kernel(sd[f"{p}.mlp.dense_h_to_4h.weight"])
+        out["mlp/up_proj/bias"] = _np(sd[f"{p}.mlp.dense_h_to_4h.bias"])
+        out["mlp/down_proj/kernel"] = linear_kernel(sd[f"{p}.mlp.dense_4h_to_h.weight"])
+        out["mlp/down_proj/bias"] = _np(sd[f"{p}.mlp.dense_4h_to_h.bias"])
+        return out
+
+
+class GPTNeoXPolicy(HFPolicy):
+    """EleutherAI/pythia + gpt-neox (reference ``containers/gptneox.py``):
+    parallel residual, partial rotary, head-interleaved fused QKV."""
+
+    model_types = ("gpt_neox",)
+
+    def build_config(self, hf, **over):
+        head_dim = hf.hidden_size // hf.num_attention_heads
+        base = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            num_layers=hf.num_hidden_layers,
+            num_heads=hf.num_attention_heads,
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            activation=ACT_MAP[hf.hidden_act],
+            position_embedding="rope",
+            rope_dim=int(head_dim * hf.rotary_pct),
+            rope_theta=getattr(hf, "rotary_emb_base",
+                               getattr(hf, "rope_theta", 10000.0)),
+            parallel_residual=hf.use_parallel_residual,
+            layernorm_epsilon=hf.layer_norm_eps,
+            tie_word_embeddings=getattr(hf, "tie_word_embeddings", False),
+        )
+        base.update(over)
+        return TransformerConfig(**base)
+
+    def top_params(self, sd, cfg):
+        out = {"embed_tokens/embedding": _np(sd["gpt_neox.embed_in.weight"])}
+        out.update(self.norm(sd, "gpt_neox.final_layer_norm", "final_norm"))
+        if not cfg.tie_word_embeddings:
+            out["lm_head/kernel"] = linear_kernel(sd["embed_out.weight"])
+        return out
+
+    def layer_params(self, sd, i, cfg):
+        p = f"gpt_neox.layers.{i}"
+        H, D = cfg.num_heads, cfg.head_dim
+        out = split_fused_qkv_headwise(
+            sd[f"{p}.attention.query_key_value.weight"], H, D,
+            bias=sd[f"{p}.attention.query_key_value.bias"])
+        out["attn/o_proj/kernel"] = o_kernel(sd[f"{p}.attention.dense.weight"],
+                                             H, D)
+        out["attn/o_proj/bias"] = _np(sd[f"{p}.attention.dense.bias"])
+        out.update(self.norm(sd, f"{p}.input_layernorm", "input_norm"))
+        out.update(self.norm(sd, f"{p}.post_attention_layernorm",
+                             "post_attn_norm"))
+        out["mlp/up_proj/kernel"] = linear_kernel(
+            sd[f"{p}.mlp.dense_h_to_4h.weight"])
+        out["mlp/up_proj/bias"] = _np(sd[f"{p}.mlp.dense_h_to_4h.bias"])
+        out["mlp/down_proj/kernel"] = linear_kernel(
+            sd[f"{p}.mlp.dense_4h_to_h.weight"])
+        out["mlp/down_proj/bias"] = _np(sd[f"{p}.mlp.dense_4h_to_h.bias"])
+        return out
+
+
+class GPTJPolicy(HFPolicy):
+    """gpt-j (reference ``containers/gptj.py``): parallel residual with a
+    single shared layernorm, interleaved partial rotary, biasless attention,
+    biased lm_head."""
+
+    model_types = ("gptj",)
+
+    def build_config(self, hf, **over):
+        base = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.n_embd,
+            num_layers=hf.n_layer,
+            num_heads=hf.n_head,
+            ffn_hidden_size=(hf.n_inner or 4 * hf.n_embd),
+            max_seq_len=hf.n_positions,
+            activation=ACT_MAP[hf.activation_function],
+            position_embedding="rope",
+            rope_dim=hf.rotary_dim,
+            rope_interleaved=True,
+            parallel_residual=True,
+            shared_attn_mlp_norm=True,
+            attention_bias=False,
+            mlp_bias=True,
+            lm_head_bias=True,
+            layernorm_epsilon=hf.layer_norm_epsilon,
+            tie_word_embeddings=getattr(hf, "tie_word_embeddings", False),
+        )
+        base.update(over)
+        return TransformerConfig(**base)
+
+    def top_params(self, sd, cfg):
+        out = {"embed_tokens/embedding": _np(sd["transformer.wte.weight"])}
+        out.update(self.norm(sd, "transformer.ln_f", "final_norm"))
+        if not cfg.tie_word_embeddings:
+            out["lm_head/kernel"] = linear_kernel(sd["lm_head.weight"])
+            out["lm_head/bias"] = _np(sd["lm_head.bias"])
+        return out
+
+    def layer_params(self, sd, i, cfg):
+        p = f"transformer.h.{i}"
+        out = self.attn_separate(sd, f"{p}.attn", cfg, out_name="out_proj")
+        out.update(self.norm(sd, f"{p}.ln_1", "input_norm"))
+        out["mlp/up_proj/kernel"] = linear_kernel(sd[f"{p}.mlp.fc_in.weight"])
+        out["mlp/up_proj/bias"] = _np(sd[f"{p}.mlp.fc_in.bias"])
+        out["mlp/down_proj/kernel"] = linear_kernel(sd[f"{p}.mlp.fc_out.weight"])
+        out["mlp/down_proj/bias"] = _np(sd[f"{p}.mlp.fc_out.bias"])
+        return out
+
+
+ALL_POLICIES = [OPTPolicy, GPT2Policy, LlamaPolicy, BloomPolicy,
+                GPTNeoXPolicy, GPTJPolicy]

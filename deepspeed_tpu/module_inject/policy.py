@@ -1,0 +1,158 @@
+"""HF-architecture injection policies — base class and weight transforms.
+
+TPU-native counterpart of reference ``module_inject/policy.py:42``
+(``TransformerPolicy`` ABC) + ``module_inject/containers/*``.  The reference
+describes, per architecture, where to find qkv/mlp weights so it can swap
+modules for fused CUDA kernels and slice weights for TP.  Here a policy maps
+an HF torch model onto the framework's flax ``Transformer`` (the single
+injected implementation — "kernel injection" is XLA compilation):
+
+* ``build_config(hf_config)`` → ``TransformerConfig`` capturing the
+  architecture (activation, norm type, rope layout, residual topology);
+* ``convert(state_dict, cfg)`` → flat ``{our-param-path: np.ndarray}`` with
+  torch→flax layout transforms (transpose, fused-qkv split, head reshape).
+
+TP then happens by sharding annotation (``runtime/zero/partition.py``
+``DEFAULT_TP_RULES`` match the converted names), not by weight surgery.
+"""
+
+import numpy as np
+
+from deepspeed_tpu.models.transformer import TransformerConfig
+from deepspeed_tpu.utils.logging import logger
+
+# HF activation-string → TransformerConfig.activation.  HF "gelu" is the
+# exact erf form; "gelu_new"/tanh variants map to flax's default tanh approx.
+ACT_MAP = {
+    "relu": "relu",
+    "gelu": "gelu_exact",
+    "gelu_new": "gelu",
+    "gelu_fast": "gelu",
+    "gelu_pytorch_tanh": "gelu",
+    "silu": "silu",
+    "swish": "silu",
+}
+
+
+def _np(t):
+    """torch tensor → float32 numpy (host)."""
+    return t.detach().cpu().float().numpy()
+
+
+def linear_kernel(w):
+    """torch Linear weight [out, in] → flax kernel [in, out]."""
+    return np.ascontiguousarray(_np(w).T)
+
+
+def qkv_kernel(w, heads, head_dim):
+    """torch [H*D, in] → flax DenseGeneral kernel [in, H, D]."""
+    return np.ascontiguousarray(_np(w).T.reshape(-1, heads, head_dim))
+
+
+def qkv_bias(b, heads, head_dim):
+    return _np(b).reshape(heads, head_dim)
+
+
+def o_kernel(w, heads, head_dim):
+    """torch [hidden, H*D] → flax DenseGeneral kernel [H, D, hidden]."""
+    return np.ascontiguousarray(_np(w).T.reshape(heads, head_dim, -1))
+
+
+def split_fused_qkv_headwise(w, heads, head_dim, bias=None):
+    """Split a head-interleaved fused QKV (neox/bloom layout: output rows
+    arranged [H, 3, D]) into per-projection flax kernels.
+
+    Returns dict with q/k/v kernels [in, H, D] (+ biases [H, D])."""
+    wn = _np(w).reshape(heads, 3, head_dim, -1)       # [H, 3, D, in]
+    out = {}
+    for j, name in enumerate(("q_proj", "k_proj", "v_proj")):
+        out[f"attn/{name}/kernel"] = np.ascontiguousarray(
+            wn[:, j].transpose(2, 0, 1))              # [in, H, D]
+        if bias is not None:
+            bn = _np(bias).reshape(heads, 3, head_dim)
+            out[f"attn/{name}/bias"] = np.ascontiguousarray(bn[:, j])
+    return out
+
+
+def split_fused_qkv_columns(w_in_out, heads, head_dim, bias=None):
+    """Split a column-fused QKV already in [in, 3*H*D] layout (GPT2 Conv1D)
+    into per-projection flax kernels [in, H, D]."""
+    h = heads * head_dim
+    wn = np.asarray(w_in_out)
+    out = {}
+    for j, name in enumerate(("q_proj", "k_proj", "v_proj")):
+        out[f"attn/{name}/kernel"] = np.ascontiguousarray(
+            wn[:, j * h:(j + 1) * h].reshape(-1, heads, head_dim))
+        if bias is not None:
+            bn = np.asarray(bias)
+            out[f"attn/{name}/bias"] = np.ascontiguousarray(
+                bn[j * h:(j + 1) * h].reshape(heads, head_dim))
+    return out
+
+
+class HFPolicy:
+    """Base policy.  Subclasses set ``model_types`` and implement
+    ``build_config`` / ``layer_params`` / ``top_params``."""
+
+    model_types = ()
+
+    @classmethod
+    def match(cls, hf_config):
+        return getattr(hf_config, "model_type", None) in cls.model_types
+
+    # -- architecture ---------------------------------------------------- #
+    def build_config(self, hf_config, **overrides) -> TransformerConfig:
+        raise NotImplementedError
+
+    # -- weights --------------------------------------------------------- #
+    def layer_params(self, sd, i, cfg) -> dict:
+        """{relative-path: array} for layer i (keys like
+        'attn/q_proj/kernel', 'input_norm/scale', 'mlp/up_proj/bias')."""
+        raise NotImplementedError
+
+    def top_params(self, sd, cfg) -> dict:
+        """{path: array} for embeddings / final norm / lm head."""
+        raise NotImplementedError
+
+    def convert(self, sd, cfg):
+        """Full flat param dict {path: np.ndarray} with scanned layers
+        stacked on a leading layer axis."""
+        flat = dict(self.top_params(sd, cfg))
+        per_layer = [self.layer_params(sd, i, cfg)
+                     for i in range(cfg.num_layers)]
+        keys = set(per_layer[0].keys())
+        for i, lp in enumerate(per_layer):
+            if set(lp.keys()) != keys:
+                raise ValueError(f"layer {i} parameter set differs: "
+                                 f"{set(lp.keys()) ^ keys}")
+        for key in keys:
+            flat[f"layers/{key}"] = np.stack([lp[key] for lp in per_layer])
+        return flat
+
+    # -- shared pieces --------------------------------------------------- #
+    @staticmethod
+    def norm(sd, prefix, out_name, rms=False):
+        out = {f"{out_name}/scale": _np(sd[f"{prefix}.weight"])}
+        if not rms and f"{prefix}.bias" in sd:
+            out[f"{out_name}/bias"] = _np(sd[f"{prefix}.bias"])
+        return out
+
+    @staticmethod
+    def attn_separate(sd, prefix, cfg, src_names=None, out_name="out_proj"):
+        """Separate q/k/v/out projections.  ``src_names`` maps our
+        q_proj/k_proj/v_proj onto the HF names (default: same names)."""
+        H, KVH, D = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+        src_names = src_names or {}
+        out = {}
+        for std, heads in (("q_proj", H), ("k_proj", KVH), ("v_proj", KVH)):
+            src = src_names.get(std, std)
+            out[f"attn/{std}/kernel"] = \
+                qkv_kernel(sd[f"{prefix}.{src}.weight"], heads, D)
+            if f"{prefix}.{src}.bias" in sd:
+                out[f"attn/{std}/bias"] = \
+                    qkv_bias(sd[f"{prefix}.{src}.bias"], heads, D)
+        out["attn/o_proj/kernel"] = o_kernel(sd[f"{prefix}.{out_name}.weight"],
+                                             H, D)
+        if f"{prefix}.{out_name}.bias" in sd:
+            out["attn/o_proj/bias"] = _np(sd[f"{prefix}.{out_name}.bias"])
+        return out

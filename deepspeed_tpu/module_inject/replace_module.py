@@ -1,0 +1,104 @@
+"""HF-model conversion front-end — the TPU analog of reference
+``module_inject/replace_module.py:282 replace_transformer_layer``.
+
+The reference walks an HF torch module tree and swaps each transformer layer
+for a fused-CUDA module, slicing weights across TP ranks in the process
+(``ReplaceWithTensorSlicing :31``).  Here the "replacement implementation" is
+the framework's flax ``Transformer`` compiled by XLA, so conversion is
+checkpoint-level, one-shot and whole-model:
+
+    model, params = convert_hf_model(hf_model)          # torch → flax/jax
+    engine = deepspeed_tpu.init_inference(hf_model, ...)  # does it for you
+
+TP sharding afterwards is a sharding annotation over the converted names
+(``runtime/zero/partition.py DEFAULT_TP_RULES`` / ``auto_tp.py``), executed
+by GSPMD — no per-rank weight surgery.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.transformer import Transformer
+from deepspeed_tpu.module_inject.containers import ALL_POLICIES
+from deepspeed_tpu.runtime.zero.partition import path_to_str
+from deepspeed_tpu.utils.logging import logger
+
+# HF buffer keys that are not parameters and never need converting.
+_IGNORED_KEY_PATTERNS = (".attn.bias", ".attn.masked_bias", "rotary_emb",
+                         ".attention.bias", ".attention.masked_bias")
+
+
+def policy_for(hf_config):
+    for policy_cls in ALL_POLICIES:
+        if policy_cls.match(hf_config):
+            return policy_cls()
+    raise NotImplementedError(
+        f"no injection policy for model_type="
+        f"{getattr(hf_config, 'model_type', None)!r}; supported: "
+        f"{sorted(t for p in ALL_POLICIES for t in p.model_types)}")
+
+
+def _materialize(model, flat, param_dtype=None):
+    """Fill the flax param tree of ``model`` from a flat {path: np.ndarray}
+    dict produced by a policy (keys relative to the 'params' collection)."""
+    abstract = jax.eval_shape(model.init, jax.random.key(0),
+                              {"input_ids": jnp.zeros((1, 4), jnp.int32)})
+    missing, used = [], set()
+
+    def fill(path, leaf):
+        name = path_to_str(path)
+        rel = name[len("params/"):] if name.startswith("params/") else name
+        if rel not in flat:
+            missing.append(rel)
+            return leaf
+        arr = flat[rel]
+        used.add(rel)
+        if tuple(arr.shape) != tuple(leaf.shape):
+            raise ValueError(f"converted {rel} has shape {arr.shape}, "
+                             f"model expects {leaf.shape}")
+        return jnp.asarray(arr, param_dtype or leaf.dtype)
+
+    params = jax.tree_util.tree_map_with_path(fill, abstract)
+    if missing:
+        raise KeyError(f"conversion missing parameters: {missing}")
+    unused = set(flat) - used
+    if unused:
+        logger.warning(f"conversion produced unused tensors: {sorted(unused)}")
+    return params
+
+
+def convert_hf_model(model_or_name, param_dtype=None, **config_overrides):
+    """(HF torch model | HF name/path) → (flax Transformer, params pytree).
+
+    ``config_overrides`` go into ``TransformerConfig`` (e.g.
+    ``dtype="float32"``, ``use_flash_attention=False``, ``max_seq_len=...``);
+    ``param_dtype`` overrides the stored parameter dtype."""
+    if isinstance(model_or_name, str):
+        from transformers import AutoModelForCausalLM
+        hf_model = AutoModelForCausalLM.from_pretrained(model_or_name)
+    else:
+        hf_model = model_or_name
+    hf_config = hf_model.config
+    policy = policy_for(hf_config)
+    cfg = policy.build_config(hf_config, **config_overrides)
+    sd = hf_model.state_dict()
+    flat = policy.convert(sd, cfg)
+
+    consumed_hint = [k for k in sd
+                     if not any(p in k for p in _IGNORED_KEY_PATTERNS)]
+    logger.info(f"converted {hf_config.model_type} model: "
+                f"{len(consumed_hint)} HF tensors → {len(flat)} flax tensors, "
+                f"{cfg.num_layers}L/{cfg.hidden_size}H")
+    model = Transformer(cfg)
+    params = _materialize(model, flat, param_dtype=param_dtype)
+    return model, params
+
+
+def replace_transformer_layer(orig_layer_impl=None, model=None, config=None,
+                              **kwargs):
+    """Reference-parity entry (``replace_module.py:282``): converts the whole
+    model (layer-granular swapping has no TPU analog — XLA compiles the full
+    graph) and returns (flax_model, params)."""
+    return convert_hf_model(model, **kwargs)

@@ -66,15 +66,28 @@ def initialize(args=None,
                                 config=config,
                                 topology=topology)
     else:
-        engine = DeepSpeedEngine(model=model,
-                                 optimizer=optimizer,
-                                 model_parameters=model_parameters,
-                                 training_data=training_data,
-                                 lr_scheduler=lr_scheduler,
-                                 collate_fn=collate_fn,
-                                 config=config,
-                                 loss_fn=loss_fn,
-                                 topology=topology)
+        # Hybrid engine for RLHF rollout+train (reference __init__.py:150-190
+        # chooses DeepSpeedHybridEngine on config.hybrid_engine.enabled)
+        cfg_dict = config
+        if isinstance(config, str):
+            import json
+            with open(config) as f:
+                cfg_dict = json.load(f)
+        hybrid = isinstance(cfg_dict, dict) and \
+            cfg_dict.get("hybrid_engine", {}).get("enabled", False)
+        engine_cls = DeepSpeedEngine
+        if hybrid:
+            from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+            engine_cls = DeepSpeedHybridEngine
+        engine = engine_cls(model=model,
+                            optimizer=optimizer,
+                            model_parameters=model_parameters,
+                            training_data=training_data,
+                            lr_scheduler=lr_scheduler,
+                            collate_fn=collate_fn,
+                            config=config,
+                            loss_fn=loss_fn,
+                            topology=topology)
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 

@@ -138,55 +138,9 @@ class InferenceEngine:
         key = ("gen", prompt_len, max_new_tokens, do_sample, temperature, top_k, top_p)
         if key in self._compiled:
             return self._compiled[key]
-        module = self.module
-        max_len = prompt_len + max_new_tokens
-
-        def sample_fn(logits, rng):
-            logits = logits.astype(jnp.float32)
-            if not do_sample:
-                return jnp.argmax(logits, axis=-1)
-            if temperature != 1.0:
-                logits = logits / jnp.maximum(temperature, 1e-6)
-            if top_k > 0:
-                kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
-                logits = jnp.where(logits < kth, -1e30, logits)
-            if 0.0 < top_p < 1.0:
-                sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-                probs = jax.nn.softmax(sorted_logits, axis=-1)
-                cum = jnp.cumsum(probs, axis=-1)
-                cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
-                cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
-                logits = jnp.where(logits < cutoff, -1e30, logits)
-            return jax.random.categorical(rng, logits, axis=-1)
-
-        def generate(params, input_ids, rng, eos_id):
-            B = input_ids.shape[0]
-            cache = module.init_cache(B, max_len, dtype=self.compute_dtype)
-            # prefill the prompt in one pass
-            logits, cache = module.apply(params, input_ids, cache, 0,
-                                         method=type(module).decode)
-            rng, sub = jax.random.split(rng)
-            next_tok = sample_fn(logits[:, -1], sub)
-
-            def step(carry, _):
-                tok, cache, pos, rng, done = carry
-                logits, cache = module.apply(params, tok[:, None], cache, pos,
-                                             method=type(module).decode)
-                rng, sub = jax.random.split(rng)
-                nxt = sample_fn(logits[:, -1], sub)
-                nxt = jnp.where(done, eos_id, nxt)
-                done = done | (nxt == eos_id)
-                return (nxt, cache, pos + 1, rng, done), nxt
-
-            done0 = (next_tok == eos_id)
-            (_, _, _, _, _), toks = jax.lax.scan(
-                step, (next_tok, cache, jnp.asarray(prompt_len), rng, done0),
-                None, length=max_new_tokens - 1)
-            # HF contract: prompt + generated tokens
-            return jnp.concatenate([input_ids, next_tok[:, None], toks.T],
-                                   axis=1)
-
-        self._compiled[key] = jax.jit(generate)
+        self._compiled[key] = make_generate_fn(
+            self.module, self.compute_dtype, prompt_len, max_new_tokens,
+            do_sample, temperature, top_k, top_p)
         return self._compiled[key]
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
@@ -212,3 +166,59 @@ class InferenceEngine:
                                 bool(do_sample), float(temperature), int(top_k),
                                 float(top_p))
         return fn(self._params, input_ids, rng, jnp.asarray(eos_token_id))
+
+
+def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
+                     do_sample, temperature, top_k, top_p):
+    """Build the jitted generation program: one-pass prefill + lax.scan
+    decode loop with greedy / temperature / top-k / top-p sampling.  Shared
+    by ``InferenceEngine`` and ``DeepSpeedHybridEngine``.
+
+    Returns ``fn(params, input_ids, rng, eos_id) -> [B, prompt+new]``."""
+    max_len = prompt_len + max_new_tokens
+
+    def sample_fn(logits, rng):
+        logits = logits.astype(jnp.float32)
+        if not do_sample:
+            return jnp.argmax(logits, axis=-1)
+        if temperature != 1.0:
+            logits = logits / jnp.maximum(temperature, 1e-6)
+        if top_k > 0:
+            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        if 0.0 < top_p < 1.0:
+            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+            cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+            logits = jnp.where(logits < cutoff, -1e30, logits)
+        return jax.random.categorical(rng, logits, axis=-1)
+
+    def generate(params, input_ids, rng, eos_id):
+        B = input_ids.shape[0]
+        cache = module.init_cache(B, max_len, dtype=compute_dtype)
+        # prefill the prompt in one pass
+        logits, cache = module.apply(params, input_ids, cache, 0,
+                                     method=type(module).decode)
+        rng, sub = jax.random.split(rng)
+        next_tok = sample_fn(logits[:, -1], sub)
+
+        def step(carry, _):
+            tok, cache, pos, rng, done = carry
+            logits, cache = module.apply(params, tok[:, None], cache, pos,
+                                         method=type(module).decode)
+            rng, sub = jax.random.split(rng)
+            nxt = sample_fn(logits[:, -1], sub)
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+            return (nxt, cache, pos + 1, rng, done), nxt
+
+        done0 = (next_tok == eos_id)
+        (_, _, _, _, _), toks = jax.lax.scan(
+            step, (next_tok, cache, jnp.asarray(prompt_len), rng, done0),
+            None, length=max_new_tokens - 1)
+        # HF contract: prompt + generated tokens
+        return jnp.concatenate([input_ids, next_tok[:, None], toks.T], axis=1)
+
+    return jax.jit(generate)

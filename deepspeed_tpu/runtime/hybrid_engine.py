@@ -1,0 +1,164 @@
+"""DeepSpeedHybridEngine — one weight set, two compiled programs.
+
+Reference parity: ``runtime/hybrid_engine.py:32`` (``DeepSpeedHybridEngine``)
+— the RLHF workhorse that flips a ZeRO-3 training model into injected-kernel
+inference for rollout ``generate`` (``:178``), fusing LoRA adapters before
+and unfusing after (``:130-165``).
+
+TPU-native design: the training engine owns the fp32 master params under the
+ZeRO sharding plan; ``generate`` runs the same jitted prefill+scan decode
+loop as ``InferenceEngine`` against a bf16 *view* of those params produced by
+one jitted cast-and-reshard program (all-gather of the ZeRO shards happens
+once per rollout batch inside that program — the analog of the reference's
+inference-container population ``:84-130``).  The view is cached and
+invalidated on every optimizer step, so back-to-back rollouts pay the gather
+once.  Train step and decode loop are two cached XLA executables over the
+same buffers — no weight copying between "modes".
+"""
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._infer_params = None
+        self._infer_params_step = -1
+        self._gen_compiled = {}
+        self._cast_fn = None
+        self._lora_spec = None
+        self._lora_fused = False
+        self._gen_rng = jax.random.key(0)
+        # rollout/train latency bookkeeping (reference hybrid_engine fields)
+        self._generate_latency = 0.0
+        self._training_latency = 0.0
+
+    # ------------------------------------------------------------------ #
+    # Inference view of the training params
+    # ------------------------------------------------------------------ #
+    def _inference_view(self):
+        """bf16 (compute-dtype), TP-sharded / ZeRO-gathered view of the
+        current master params; rebuilt only after an optimizer step."""
+        if self._infer_params is not None and \
+                self._infer_params_step == self.global_steps:
+            return self._infer_params
+        if self._params is None:
+            # RLHF generates before the first train step — init params now
+            # (sharded at birth), same as the first forward would.
+            seq = min(8, self.module.config.max_seq_len) \
+                if hasattr(self.module, "config") else 8
+            dummy = {"input_ids": jnp.zeros((1, seq), jnp.int32)}
+            self._lazy_init((dummy,), {})
+        if self._cast_fn is None:
+            cast = self.compute_dtype
+            # inference placement: keep TP sharding, drop ZeRO scattering
+            # (replicate over dp) so each decode step is gather-free.
+            from deepspeed_tpu.runtime.zero.partition import tp_spec_for, \
+                path_to_str
+
+            def spec_of(path, leaf):
+                return NamedSharding(
+                    self.mesh, tp_spec_for(path_to_str(path), leaf.shape,
+                                           self.mesh))
+            abstract = jax.tree.map(
+                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype),
+                self._params)
+            shardings = jax.tree_util.tree_map_with_path(spec_of, abstract)
+            self._cast_fn = jax.jit(
+                lambda t: jax.tree.map(
+                    lambda p: p.astype(cast)
+                    if jnp.issubdtype(p.dtype, jnp.floating) else p, t),
+                out_shardings=shardings)
+        params = self._params
+        if self._lora_spec is not None and not self._lora_fused:
+            params = _fuse_lora(params, self._lora_spec)
+        self._infer_params = self._cast_fn(params)
+        self._infer_params_step = self.global_steps
+        return self._infer_params
+
+    # ------------------------------------------------------------------ #
+    # LoRA (reference hybrid_engine fuse_lora_weight/unfuse_lora_weight)
+    # ------------------------------------------------------------------ #
+    def set_lora(self, lora_spec):
+        """Register LoRA adapters: {param-path: (A [in,r], B [r,out],
+        scaling)} — fused into the inference view (and optionally the master
+        weights) like the reference's ``_fuse_lora`` (:130)."""
+        self._lora_spec = lora_spec
+        self._infer_params = None
+
+    def fuse_lora_weight(self):
+        """Fuse LoRA deltas into the master weights in-place."""
+        if self._lora_spec is None or self._lora_fused:
+            return
+        if self._params is None:
+            raise RuntimeError("fuse_lora_weight() before parameters exist; "
+                               "run a forward or generate first")
+        self._params = _fuse_lora(self._params, self._lora_spec)
+        self._lora_fused = True
+        self._infer_params = None
+
+    def unfuse_lora_weight(self):
+        if self._lora_spec is None or not self._lora_fused:
+            return
+        self._params = _fuse_lora(self._params, self._lora_spec, sign=-1.0)
+        self._lora_fused = False
+        self._infer_params = None
+
+    # ------------------------------------------------------------------ #
+    # Rollout generation (reference hybrid_engine.generate :178)
+    # ------------------------------------------------------------------ #
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 temperature=1.0, top_k=0, top_p=1.0, eos_token_id=-1,
+                 seed=None):
+        from deepspeed_tpu.inference.engine import make_generate_fn
+        import time
+        t0 = time.time()
+        input_ids = jnp.asarray(input_ids)
+        if seed is not None:
+            self._gen_rng = jax.random.key(seed)
+        self._gen_rng, rng = jax.random.split(self._gen_rng)
+        key = (input_ids.shape[1], int(max_new_tokens), bool(do_sample),
+               float(temperature), int(top_k), float(top_p))
+        if key not in self._gen_compiled:
+            self._gen_compiled[key] = make_generate_fn(
+                self.module, self.compute_dtype, input_ids.shape[1],
+                int(max_new_tokens), bool(do_sample), float(temperature),
+                int(top_k), float(top_p))
+        params = self._inference_view()
+        out = self._gen_compiled[key](params, input_ids, rng,
+                                      jnp.asarray(eos_token_id))
+        out.block_until_ready()
+        self._generate_latency += time.time() - t0
+        return out
+
+
+@partial(jax.jit, static_argnames=("sign",))
+def _fuse_lora_jit(params, lora_spec, sign):
+    from deepspeed_tpu.runtime.zero.partition import path_to_str
+
+    def one(path, w):
+        entry = lora_spec.get(path_to_str(path))
+        if entry is None:
+            return w
+        a, b, scale = entry
+        delta = (a.reshape(a.shape[0], -1) @ b.reshape(b.shape[0], -1))
+        return w + (sign * scale * delta.reshape(w.shape)).astype(w.dtype)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def _fuse_lora(params, lora_spec, sign=1.0):
+    """W ← W + sign·scale·(A@B) for every (path, (A, B, scale)) entry.
+    Module-level jit so repeated fuses (one per train-step/rollout cycle)
+    hit the executable cache."""
+    return _fuse_lora_jit(params, lora_spec, sign=float(sign))

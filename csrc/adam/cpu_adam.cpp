@@ -1,0 +1,142 @@
+// SIMD-vectorized host Adam/AdamW for offloaded optimizer states.
+//
+// TPU-native equivalent of reference csrc/adam/cpu_adam.cpp (+ simd.h):
+// the ZeRO-Offload host optimizer. Same design — flat fp32 state arrays on
+// host memory, vectorized elementwise update, optional 16-bit param copy-out
+// for the device upload — but bound via a plain C ABI (ctypes) instead of
+// pybind11, and the 16-bit side is bfloat16 (TPU native) rather than fp16.
+//
+// Vectorization strategy: the inner loops are written so GCC/Clang
+// auto-vectorize them at -O3 -march=native (verified: AVX2/AVX-512 on x86,
+// NEON on aarch64), with OpenMP across cores. This replaces the reference's
+// hand-written AVX256/AVX512 intrinsics (csrc/includes/simd.h) with the same
+// effective ILP and far less code.
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// Round-to-nearest-even float32 -> bfloat16 (matches XLA/TPU semantics).
+static inline uint16_t float_to_bf16(float f) {
+    uint32_t x;
+    std::memcpy(&x, &f, sizeof(x));
+    uint32_t rounding_bias = 0x7fff + ((x >> 16) & 1);
+    return static_cast<uint16_t>((x + rounding_bias) >> 16);
+}
+
+// One fused Adam/AdamW step over a contiguous fp32 shard.
+//   adamw_mode=1: decoupled weight decay (AdamW); 0: L2-style (classic Adam).
+//   bias_correction=1 applies the standard 1/(1-beta^t) correction.
+//   bf16_out: optional (may be null) bfloat16 copy of updated params for the
+//             host->device upload of the 16-bit working weights.
+void ds_adam_step(float* params,
+                  float* exp_avg,
+                  float* exp_avg_sq,
+                  const float* grads,
+                  int64_t n,
+                  float lr,
+                  float beta1,
+                  float beta2,
+                  float eps,
+                  float weight_decay,
+                  int adamw_mode,
+                  int bias_correction,
+                  int step,
+                  uint16_t* bf16_out) {
+    float bc1 = 1.0f, bc2 = 1.0f;
+    if (bias_correction) {
+        bc1 = 1.0f - std::pow(beta1, (float)step);
+        bc2 = 1.0f - std::pow(beta2, (float)step);
+    }
+    const float step_size = lr / bc1;
+    const float bc2_sqrt = std::sqrt(bc2);
+    const float w_decay = (adamw_mode && weight_decay > 0.0f)
+                              ? (1.0f - lr * weight_decay)
+                              : 1.0f;
+
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+    for (int64_t i = 0; i < n; ++i) {
+        float g = grads[i];
+        float p = params[i];
+        if (!adamw_mode && weight_decay > 0.0f) g += weight_decay * p;
+        float m = exp_avg[i] * beta1 + g * (1.0f - beta1);
+        float v = exp_avg_sq[i] * beta2 + g * g * (1.0f - beta2);
+        float denom = std::sqrt(v) / bc2_sqrt + eps;
+        p = p * w_decay - step_size * (m / denom);
+        params[i] = p;
+        exp_avg[i] = m;
+        exp_avg_sq[i] = v;
+        if (bf16_out) bf16_out[i] = float_to_bf16(p);
+    }
+}
+
+// Fused host Adagrad step (reference csrc/adagrad/cpu_adagrad.cpp).
+void ds_adagrad_step(float* params,
+                     float* exp_avg_sq,
+                     const float* grads,
+                     int64_t n,
+                     float lr,
+                     float eps,
+                     float weight_decay,
+                     uint16_t* bf16_out) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+    for (int64_t i = 0; i < n; ++i) {
+        float g = grads[i];
+        float p = params[i];
+        if (weight_decay > 0.0f) g += weight_decay * p;
+        float v = exp_avg_sq[i] + g * g;
+        p -= lr * g / (std::sqrt(v) + eps);
+        params[i] = p;
+        exp_avg_sq[i] = v;
+        if (bf16_out) bf16_out[i] = float_to_bf16(p);
+    }
+}
+
+// Fused host LAMB trust-ratio step on a single shard (two-pass: caller
+// supplies per-shard param/update norms pre-reduced across shards).
+void ds_lamb_apply(float* params,
+                   const float* update,  // m_hat/denom + wd*p, precomputed
+                   int64_t n,
+                   float lr,
+                   float trust_ratio,
+                   uint16_t* bf16_out) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+    for (int64_t i = 0; i < n; ++i) {
+        float p = params[i] - lr * trust_ratio * update[i];
+        params[i] = p;
+        if (bf16_out) bf16_out[i] = float_to_bf16(p);
+    }
+}
+
+// fp32 <- bf16 widening copy (device download path).
+void ds_bf16_to_fp32(const uint16_t* src, float* dst, int64_t n) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+    for (int64_t i = 0; i < n; ++i) {
+        uint32_t x = static_cast<uint32_t>(src[i]) << 16;
+        std::memcpy(&dst[i], &x, sizeof(float));
+    }
+}
+
+int ds_adam_num_threads(void) {
+#if defined(_OPENMP)
+    return omp_get_max_threads();
+#else
+    return 1;
+#endif
+}
+
+}  // extern "C"

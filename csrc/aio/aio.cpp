@@ -1,0 +1,214 @@
+// Async tensor I/O host library: the NVMe tier under optimizer/param offload.
+//
+// TPU-native equivalent of reference csrc/aio/ (libaio O_DIRECT async
+// read/write with worker threads + bounce buffers, py_lib/deepspeed_aio_thread
+// .cpp / deepspeed_py_aio_handle.cpp). Same architecture — a handle owns a
+// pool of I/O threads; submissions are split into block_size chunks fanned
+// across the pool; wait() drains completions — but implemented with portable
+// POSIX pread/pwrite on a std::thread pool (io_uring/libaio headers are not
+// guaranteed in this image), exposed through a C ABI for ctypes binding
+// (reference binds via pybind11, csrc/aio/py_lib/py_ds_aio.cpp).
+//
+// O_DIRECT is honored when requested and the buffer/offset alignment allows,
+// falling back to buffered I/O otherwise (reference fallback behaviour in
+// deepspeed_aio_common.cpp).
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct IoTask {
+    std::function<int64_t()> fn;
+};
+
+class AioHandle {
+  public:
+    AioHandle(int num_threads, int64_t block_size, bool o_direct)
+        : block_size_(block_size > 0 ? block_size : (1 << 20)),
+          o_direct_(o_direct),
+          pending_(0),
+          errors_(0),
+          stop_(false) {
+        if (num_threads <= 0) num_threads = 1;
+        for (int i = 0; i < num_threads; ++i)
+            workers_.emplace_back([this] { worker_loop(); });
+    }
+
+    ~AioHandle() {
+        {
+            std::lock_guard<std::mutex> lk(mu_);
+            stop_ = true;
+        }
+        cv_.notify_all();
+        for (auto& t : workers_) t.join();
+    }
+
+    int num_threads() const { return (int)workers_.size(); }
+    int64_t block_size() const { return block_size_; }
+
+    // Split [0, nbytes) into block_size chunks and enqueue one task each.
+    // write=true: buf -> file; write=false: file -> buf.
+    int submit(const std::string& path, char* buf, int64_t nbytes, bool write,
+               bool validate) {
+        if (write) {
+            // Create/truncate up-front so chunk writers can pwrite anywhere.
+            int flags = O_WRONLY | O_CREAT | O_TRUNC;
+            int fd = ::open(path.c_str(), flags, 0644);
+            if (fd < 0) return -1;
+            ::close(fd);
+        } else if (validate) {
+            struct stat st;
+            if (::stat(path.c_str(), &st) != 0) return -1;
+            if (st.st_size < nbytes) return -2;
+        }
+        int64_t n_chunks = (nbytes + block_size_ - 1) / block_size_;
+        if (n_chunks == 0) n_chunks = 1;
+        {
+            std::lock_guard<std::mutex> lk(mu_);
+            for (int64_t c = 0; c < n_chunks; ++c) {
+                int64_t off = c * block_size_;
+                int64_t len = std::min(block_size_, nbytes - off);
+                if (len < 0) len = 0;
+                pending_++;
+                tasks_.push(IoTask{[this, path, buf, off, len, write]() {
+                    return do_chunk(path, buf + off, off, len, write);
+                }});
+            }
+        }
+        cv_.notify_all();
+        return 0;
+    }
+
+    // Block until all submitted work is done; returns -(#errors) or 0.
+    int wait() {
+        std::unique_lock<std::mutex> lk(mu_);
+        done_cv_.wait(lk, [this] { return pending_ == 0; });
+        int e = errors_.exchange(0);
+        return e > 0 ? -e : 0;
+    }
+
+  private:
+    int64_t do_chunk(const std::string& path, char* buf, int64_t off,
+                     int64_t len, bool write) {
+        int flags = write ? O_WRONLY : O_RDONLY;
+#ifdef O_DIRECT
+        bool direct = o_direct_ && (reinterpret_cast<uintptr_t>(buf) % 4096 == 0) &&
+                      (off % 4096 == 0) && (len % 4096 == 0);
+        if (direct) flags |= O_DIRECT;
+#endif
+        int fd = ::open(path.c_str(), flags);
+#ifdef O_DIRECT
+        if (fd < 0 && (flags & O_DIRECT)) {
+            flags &= ~O_DIRECT;  // filesystem may refuse O_DIRECT
+            fd = ::open(path.c_str(), flags);
+        }
+#endif
+        if (fd < 0) return -1;
+        int64_t total = 0;
+        while (total < len) {
+            ssize_t r = write ? ::pwrite(fd, buf + total, len - total, off + total)
+                              : ::pread(fd, buf + total, len - total, off + total);
+            if (r <= 0) {
+                ::close(fd);
+                return -1;
+            }
+            total += r;
+        }
+        ::close(fd);
+        return total;
+    }
+
+    void worker_loop() {
+        for (;;) {
+            IoTask task;
+            {
+                std::unique_lock<std::mutex> lk(mu_);
+                cv_.wait(lk, [this] { return stop_ || !tasks_.empty(); });
+                if (stop_ && tasks_.empty()) return;
+                task = std::move(tasks_.front());
+                tasks_.pop();
+            }
+            int64_t rc = task.fn();
+            if (rc < 0) errors_++;
+            {
+                std::lock_guard<std::mutex> lk(mu_);
+                if (--pending_ == 0) done_cv_.notify_all();
+            }
+        }
+    }
+
+    int64_t block_size_;
+    bool o_direct_;
+    std::vector<std::thread> workers_;
+    std::queue<IoTask> tasks_;
+    std::mutex mu_;
+    std::condition_variable cv_;
+    std::condition_variable done_cv_;
+    int64_t pending_;
+    std::atomic<int> errors_;
+    bool stop_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* aio_handle_create(int num_threads, int64_t block_size, int o_direct) {
+    return new AioHandle(num_threads, block_size, o_direct != 0);
+}
+
+void aio_handle_destroy(void* h) { delete static_cast<AioHandle*>(h); }
+
+int aio_handle_num_threads(void* h) {
+    return static_cast<AioHandle*>(h)->num_threads();
+}
+
+int64_t aio_handle_block_size(void* h) {
+    return static_cast<AioHandle*>(h)->block_size();
+}
+
+// Async submissions (reference async_pwrite/async_pread,
+// deepspeed_py_aio_handle.cpp). Pair with aio_wait.
+int aio_async_pwrite(void* h, const char* path, const void* buf, int64_t n) {
+    return static_cast<AioHandle*>(h)->submit(
+        path, const_cast<char*>(static_cast<const char*>(buf)), n, true, false);
+}
+
+int aio_async_pread(void* h, const char* path, void* buf, int64_t n) {
+    return static_cast<AioHandle*>(h)->submit(path, static_cast<char*>(buf), n,
+                                              false, true);
+}
+
+int aio_wait(void* h) { return static_cast<AioHandle*>(h)->wait(); }
+
+// Synchronous convenience wrappers (reference sync_pwrite/sync_pread).
+int aio_sync_pwrite(void* h, const char* path, const void* buf, int64_t n) {
+    AioHandle* handle = static_cast<AioHandle*>(h);
+    int rc = handle->submit(
+        path, const_cast<char*>(static_cast<const char*>(buf)), n, true, false);
+    if (rc != 0) return rc;
+    return handle->wait();
+}
+
+int aio_sync_pread(void* h, const char* path, void* buf, int64_t n) {
+    AioHandle* handle = static_cast<AioHandle*>(h);
+    int rc = handle->submit(path, static_cast<char*>(buf), n, false, true);
+    if (rc != 0) return rc;
+    return handle->wait();
+}
+
+}  // extern "C"

@@ -85,7 +85,7 @@ def initialize(args=None,
                             training_data=training_data,
                             lr_scheduler=lr_scheduler,
                             collate_fn=collate_fn,
-                            config=config,
+                            config=cfg_dict,
                             loss_fn=loss_fn,
                             topology=topology)
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler

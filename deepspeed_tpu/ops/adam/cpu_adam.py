@@ -1,0 +1,153 @@
+"""Host (CPU) Adam for offloaded optimizer states — ZeRO-Offload's engine.
+
+TPU-native equivalent of reference ``deepspeed/ops/adam/cpu_adam.py:13``
+(DeepSpeedCPUAdam) over ``csrc/adam/cpu_adam.cpp``: optimizer states live in
+host RAM as fp32 numpy arrays; the update is a C++ OpenMP+SIMD kernel
+(``csrc/adam/cpu_adam.cpp`` here, built lazily via ctypes); the updated
+params are narrowed to bfloat16 in the same pass for the host->device upload
+(reference's fp16 copy-back, ``cpu_adam.cpp`` param_half path).
+
+Falls back to a vectorized numpy implementation when the C++ toolchain is
+unavailable so the offload path stays functional everywhere.
+"""
+
+import ctypes
+
+import numpy as np
+
+_lib = None
+_lib_err = None
+
+
+def _load():
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    try:
+        from deepspeed_tpu.ops.native_build import load_library, csrc_path
+        lib = load_library("ds_cpu_adam", [csrc_path("adam", "cpu_adam.cpp")])
+        lib.ds_adam_step.restype = None
+        lib.ds_adam_step.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_void_p]
+        lib.ds_adagrad_step.restype = None
+        lib.ds_adagrad_step.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_void_p]
+        _lib = lib
+    except Exception as e:  # toolchain missing: numpy fallback
+        _lib_err = e
+        _lib = None
+    return _lib
+
+
+def is_available():
+    """True when the native kernel built (ds_report probing,
+    reference op_builder/cpu_adam.py CPUAdamBuilder.is_compatible)."""
+    return _load() is not None
+
+
+def build_error():
+    _load()
+    return _lib_err
+
+
+def _ptr(a):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def adam_step(params, exp_avg, exp_avg_sq, grads, lr, beta1, beta2, eps,
+              weight_decay, adamw_mode, bias_correction, step, bf16_out=None):
+    """In-place fused Adam over contiguous fp32 numpy arrays."""
+    n = params.size
+    lib = _load()
+    if lib is not None:
+        lib.ds_adam_step(_ptr(params), _ptr(exp_avg), _ptr(exp_avg_sq),
+                         _ptr(grads), n, lr, beta1, beta2, eps, weight_decay,
+                         int(adamw_mode), int(bias_correction), int(step),
+                         _ptr(bf16_out) if bf16_out is not None else None)
+        return
+    # numpy fallback (same math, see csrc/adam/cpu_adam.cpp)
+    bc1 = 1.0 - beta1 ** step if bias_correction else 1.0
+    bc2 = 1.0 - beta2 ** step if bias_correction else 1.0
+    g = grads
+    if not adamw_mode and weight_decay > 0.0:
+        g = g + weight_decay * params
+    np.multiply(exp_avg, beta1, out=exp_avg)
+    exp_avg += (1.0 - beta1) * g
+    np.multiply(exp_avg_sq, beta2, out=exp_avg_sq)
+    exp_avg_sq += (1.0 - beta2) * np.square(g)
+    denom = np.sqrt(exp_avg_sq) / np.sqrt(bc2) + eps
+    if adamw_mode and weight_decay > 0.0:
+        params *= 1.0 - lr * weight_decay
+    params -= (lr / bc1) * (exp_avg / denom)
+    if bf16_out is not None:
+        _np_f32_to_bf16(params, bf16_out)
+
+
+def adagrad_step(params, exp_avg_sq, grads, lr, eps, weight_decay, bf16_out=None):
+    """In-place fused Adagrad (reference csrc/adagrad/cpu_adagrad.cpp)."""
+    lib = _load()
+    if lib is not None:
+        lib.ds_adagrad_step(_ptr(params), _ptr(exp_avg_sq), _ptr(grads),
+                            params.size, lr, eps, weight_decay,
+                            _ptr(bf16_out) if bf16_out is not None else None)
+        return
+    g = grads
+    if weight_decay > 0.0:
+        g = g + weight_decay * params
+    exp_avg_sq += np.square(g)
+    params -= lr * g / (np.sqrt(exp_avg_sq) + eps)
+    if bf16_out is not None:
+        _np_f32_to_bf16(params, bf16_out)
+
+
+def _np_f32_to_bf16(src, out_u16):
+    x = src.view(np.uint32)
+    rounding = np.uint32(0x7FFF) + ((x >> np.uint32(16)) & np.uint32(1))
+    np.copyto(out_u16, ((x + rounding) >> np.uint32(16)).astype(np.uint16))
+
+
+class DeepSpeedCPUAdam:
+    """Stateful host Adam over a list of flat fp32 shards (reference
+    ``deepspeed/ops/adam/cpu_adam.py:13`` API shape: per-group step with
+    fp16 (here bf16) copy-out).
+
+    ``params`` is a list of 1-D fp32 numpy arrays (the host-resident master
+    shards). ``step(grads, bf16_outs)`` updates them in place.
+    """
+
+    def __init__(self, params, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, bias_correction=True, adamw_mode=True):
+        self.params = [np.ascontiguousarray(p, dtype=np.float32) for p in params]
+        self.exp_avg = [np.zeros_like(p) for p in self.params]
+        self.exp_avg_sq = [np.zeros_like(p) for p in self.params]
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.bias_correction = bias_correction
+        self.adamw_mode = adamw_mode
+        self.step_count = 0
+
+    def step(self, grads, bf16_outs=None, lr=None):
+        self.step_count += 1
+        lr = self.lr if lr is None else lr
+        for i, (p, g) in enumerate(zip(self.params, grads)):
+            out = bf16_outs[i] if bf16_outs is not None else None
+            adam_step(p, self.exp_avg[i], self.exp_avg_sq[i],
+                      np.ascontiguousarray(g, dtype=np.float32),
+                      lr, self.beta1, self.beta2, self.eps, self.weight_decay,
+                      self.adamw_mode, self.bias_correction, self.step_count,
+                      bf16_out=out)
+
+    def state_dict(self):
+        return {"step": self.step_count, "exp_avg": self.exp_avg,
+                "exp_avg_sq": self.exp_avg_sq}
+
+    def load_state_dict(self, sd):
+        self.step_count = sd["step"]
+        self.exp_avg = [np.ascontiguousarray(a, np.float32) for a in sd["exp_avg"]]
+        self.exp_avg_sq = [np.ascontiguousarray(a, np.float32) for a in sd["exp_avg_sq"]]

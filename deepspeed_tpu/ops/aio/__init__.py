@@ -1,0 +1,133 @@
+"""Async tensor I/O — Python binding for the native NVMe/disk tier.
+
+TPU-native equivalent of reference ``deepspeed/ops/aio`` + ``csrc/aio/py_lib``
+(AsyncIOBuilder, ``op_builder/async_io.py:12``): an ``AsyncIOHandle`` owning a
+C++ I/O thread pool (``csrc/aio/aio.cpp``) with async/sync pread/pwrite of
+numpy buffers, used by ``runtime/swap_tensor`` for optimizer-state and
+parameter offload to NVMe.
+"""
+
+import ctypes
+
+import numpy as np
+
+_lib = None
+_lib_err = None
+
+AIO_DEFAULT_BLOCK_SIZE = 1 << 20
+AIO_DEFAULT_THREADS = 8
+
+
+def _load():
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    try:
+        from deepspeed_tpu.ops.native_build import load_library, csrc_path
+        lib = load_library("ds_aio", [csrc_path("aio", "aio.cpp")],
+                           want_openmp=False)
+        lib.aio_handle_create.restype = ctypes.c_void_p
+        lib.aio_handle_create.argtypes = [ctypes.c_int, ctypes.c_int64, ctypes.c_int]
+        lib.aio_handle_destroy.argtypes = [ctypes.c_void_p]
+        lib.aio_handle_num_threads.restype = ctypes.c_int
+        lib.aio_handle_num_threads.argtypes = [ctypes.c_void_p]
+        lib.aio_handle_block_size.restype = ctypes.c_int64
+        lib.aio_handle_block_size.argtypes = [ctypes.c_void_p]
+        for fn in ("aio_async_pwrite", "aio_sync_pwrite"):
+            f = getattr(lib, fn)
+            f.restype = ctypes.c_int
+            f.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                          ctypes.c_int64]
+        for fn in ("aio_async_pread", "aio_sync_pread"):
+            f = getattr(lib, fn)
+            f.restype = ctypes.c_int
+            f.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                          ctypes.c_int64]
+        lib.aio_wait.restype = ctypes.c_int
+        lib.aio_wait.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    except Exception as e:
+        _lib_err = e
+        _lib = None
+    return _lib
+
+
+def is_available():
+    return _load() is not None
+
+
+def build_error():
+    _load()
+    return _lib_err
+
+
+def _buf(a):
+    assert a.flags["C_CONTIGUOUS"], "aio buffers must be contiguous"
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+class AsyncIOHandle:
+    """Reference ``deepspeed_py_aio_handle.cpp`` aio_handle: async/sync
+    read/write with a worker pool; ``wait()`` drains all pending requests.
+
+    In-flight buffers must stay alive until ``wait()``; the handle keeps
+    references to enforce that.
+    """
+
+    def __init__(self, block_size=AIO_DEFAULT_BLOCK_SIZE,
+                 queue_depth=None, thread_count=AIO_DEFAULT_THREADS,
+                 single_submit=False, overlap_events=True, o_direct=False):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"aio native library unavailable: {_lib_err}")
+        self._lib = lib
+        self._h = lib.aio_handle_create(int(thread_count), int(block_size),
+                                        1 if o_direct else 0)
+        self._inflight = []
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.aio_handle_destroy(h)
+            self._h = None
+
+    @property
+    def num_threads(self):
+        return self._lib.aio_handle_num_threads(self._h)
+
+    @property
+    def block_size(self):
+        return self._lib.aio_handle_block_size(self._h)
+
+    def async_pwrite(self, array: np.ndarray, path: str):
+        rc = self._lib.aio_async_pwrite(self._h, path.encode(), _buf(array),
+                                        array.nbytes)
+        if rc != 0:
+            raise IOError(f"aio submit write {path} failed ({rc})")
+        self._inflight.append(array)
+
+    def async_pread(self, array: np.ndarray, path: str):
+        rc = self._lib.aio_async_pread(self._h, path.encode(), _buf(array),
+                                       array.nbytes)
+        if rc != 0:
+            raise IOError(f"aio submit read {path} failed ({rc})")
+        self._inflight.append(array)
+
+    def wait(self):
+        rc = self._lib.aio_wait(self._h)
+        self._inflight.clear()
+        if rc != 0:
+            raise IOError(f"aio completed with {-rc} failed requests")
+        return rc
+
+    def sync_pwrite(self, array: np.ndarray, path: str):
+        rc = self._lib.aio_sync_pwrite(self._h, path.encode(), _buf(array),
+                                       array.nbytes)
+        if rc != 0:
+            raise IOError(f"aio write {path} failed ({rc})")
+
+    def sync_pread(self, array: np.ndarray, path: str):
+        rc = self._lib.aio_sync_pread(self._h, path.encode(), _buf(array),
+                                      array.nbytes)
+        if rc != 0:
+            raise IOError(f"aio read {path} failed ({rc})")

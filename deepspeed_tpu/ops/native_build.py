@@ -1,0 +1,97 @@
+"""Lazy JIT build of the native (C++) host libraries.
+
+Analog of the reference's ``OpBuilder.jit_load`` path
+(``op_builder/builder.py:442,455``): compile on first use into a per-user
+cache directory keyed by a source hash, then ``ctypes.CDLL`` the result.
+The reference builds torch extensions with pybind11; here the libraries
+expose a plain C ABI and are bound with ctypes (pybind11 is not in this
+image), which also keeps them usable from non-Python tooling.
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sysconfig
+import threading
+
+from deepspeed_tpu.utils.logging import logger
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc")
+_lock = threading.Lock()
+_loaded = {}
+
+
+def csrc_path(*parts):
+    return os.path.join(_CSRC, *parts)
+
+
+def _cache_dir():
+    base = os.environ.get("DSTPU_BUILD_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "deepspeed_tpu", "build")
+    os.makedirs(base, exist_ok=True)
+    return base
+
+
+def _hash_sources(sources, flags):
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(flags).encode())
+    return h.hexdigest()[:16]
+
+
+def _try_compile(out, sources, flags):
+    cmd = ["g++", "-shared", "-fPIC", "-std=c++17", "-O3", *flags,
+           *sources, "-o", out]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        return proc.stderr
+    return None
+
+
+def jit_build(name, sources, extra_flags=(), want_openmp=True):
+    """Compile ``sources`` into a cached shared library; return its path.
+
+    Tries the fastest flag set first (-march=native -fopenmp) and degrades
+    gracefully — the reference probes CPU arch flags the same way
+    (``op_builder/builder.py`` cpu_arch/simd_width detection).
+    """
+    flag_sets = []
+    base = list(extra_flags)
+    if want_openmp:
+        flag_sets.append(base + ["-march=native", "-fopenmp"])
+        flag_sets.append(base + ["-fopenmp"])
+    flag_sets.append(base + ["-march=native"])
+    flag_sets.append(base)
+
+    tag = _hash_sources(sources, base)
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = os.path.join(_cache_dir(), f"{name}-{tag}{suffix}")
+    if os.path.exists(out):
+        return out
+    with _lock:
+        if os.path.exists(out):
+            return out
+        tmp = out + ".tmp"
+        last_err = None
+        for flags in flag_sets:
+            last_err = _try_compile(tmp, sources, flags)
+            if last_err is None:
+                os.replace(tmp, out)
+                logger.info(f"built native op {name} ({' '.join(flags)})")
+                return out
+        raise RuntimeError(f"failed to build native op {name}:\n{last_err}")
+
+
+def load_library(name, sources, extra_flags=(), want_openmp=True):
+    """jit_build + CDLL with caching; raises on toolchain failure."""
+    key = (name, tuple(sources))
+    if key in _loaded:
+        return _loaded[key]
+    path = jit_build(name, sources, extra_flags, want_openmp)
+    lib = ctypes.CDLL(path)
+    _loaded[key] = lib
+    return lib

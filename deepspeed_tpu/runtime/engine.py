@@ -59,6 +59,19 @@ from deepspeed_tpu.utils.timer import (SynchronizedWallClockTimer, ThroughputTim
 MEMORY_OPT_ALLREDUCE_SIZE = 500_000_000
 
 
+def _unscale_and_clip(grads, scale, clip):
+    """Unscale by the loss scale, compute the global grad norm, clip
+    (reference ``stage_1_and_2.py:1791`` unscale_and_clip_grads)."""
+    inv = 1.0 / scale
+    grads = jax.tree.map(lambda g: g * inv, grads)
+    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                         for g in jax.tree.leaves(grads)))
+    if clip > 0.0:
+        factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+        grads = jax.tree.map(lambda g: g * factor, grads)
+    return grads, gnorm
+
+
 def _is_flax_module(model):
     try:
         import flax.linen as nn
@@ -127,6 +140,13 @@ class DeepSpeedEngine:
         self._compiled = {}
         self._last_loss = None
         self.warn_unscaled_loss = True
+
+        # ZeRO-Offload (reference stage_1_and_2.py:1037 CPU-offload path /
+        # stage3.py:1637 NVMe): host-resident fp32 masters + moments stepped
+        # by the native C++ Adam; device keeps bf16 working params only.
+        off = self._config.zero_config.offload_optimizer
+        self._offload_cfg = off if (off is not None and off.device != "none") else None
+        self._host_opt = None
 
         self.optimizer = self.client_optimizer or build_optimizer(self._config.optimizer)
         self.lr_scheduler = self.client_lr_scheduler or build_lr_scheduler(
@@ -308,6 +328,30 @@ class DeepSpeedEngine:
         self._abstract_params = abstract_params
 
     def _init_opt_state(self):
+        if self._offload_cfg is not None:
+            from deepspeed_tpu.runtime.zero.offload import HostOffloadedAdam
+            opt = self.optimizer
+            self._host_opt = HostOffloadedAdam(
+                self._abstract_params, self._offload_cfg,
+                lr=getattr(opt, "lr", 1e-3),
+                betas=(getattr(opt, "beta1", 0.9), getattr(opt, "beta2", 0.999)),
+                eps=getattr(opt, "eps", 1e-8),
+                weight_decay=getattr(opt, "weight_decay", 0.0),
+                adamw_mode=getattr(opt, "adam_w_mode", True),
+                bias_correction=getattr(opt, "bias_correction", True))
+            self._host_opt.init_from_params(self._params)
+            # downcast device params to the compute dtype: the HBM saving
+            # that is the point of offload (masters now live on host)
+            cast = jax.jit(
+                lambda t: jax.tree.map(
+                    lambda p: p.astype(self.compute_dtype)
+                    if jnp.issubdtype(p.dtype, jnp.floating) else p, t),
+                out_shardings=self._plan.param_shardings,
+                donate_argnums=(0,))
+            self._params = cast(self._params)
+            self._opt_state = None
+            self._opt_shardings = None
+            return
         abstract_opt = jax.eval_shape(self.optimizer.init, self._abstract_params)
         self._opt_shardings = _opt_state_shardings(
             abstract_opt, self._abstract_params, self._plan.opt_specs, self.mesh)
@@ -389,6 +433,9 @@ class DeepSpeedEngine:
                     return scaled, (loss, aux)
 
                 grads, (loss, aux) = jax.grad(loss_of, has_aux=True)(params)
+                # fp32 grad accumulation even when working params are 16-bit
+                # (offload path; reference stage_1_and_2.py fp32 accum)
+                grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
                 flat = jax.tree.leaves(grads)
                 found_inf = jnp.logical_not(
                     jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in flat])))
@@ -510,13 +557,7 @@ class DeepSpeedEngine:
             scaler = self.loss_scaler
 
             def apply_update(params, opt_state, scaler_state, grads, found_inf, lr, step):
-                inv = 1.0 / scaler_state.scale
-                grads = jax.tree.map(lambda g: g * inv, grads)
-                gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                                     for g in jax.tree.leaves(grads)))
-                if clip > 0.0:
-                    factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
-                    grads = jax.tree.map(lambda g: g * factor, grads)
+                grads, gnorm = _unscale_and_clip(grads, scaler_state.scale, clip)
                 new_params, new_opt = self.optimizer.update(grads, opt_state, params,
                                                             lr=lr, step=step)
                 # branch-free overflow skip (reference stage_1_and_2.py:1808)
@@ -543,6 +584,11 @@ class DeepSpeedEngine:
             raise RuntimeError("step called with no accumulated gradients")
         if self.wall_clock_breakdown():
             self.timers(STEP_GLOBAL_TIMER).start()
+        if self._host_opt is not None:
+            self._offload_step(lr_kwargs)
+            if self.wall_clock_breakdown():
+                self.timers(STEP_GLOBAL_TIMER).stop()
+            return
         lr = jnp.asarray(self.get_lr()[0], jnp.float32)
         step_no = jnp.asarray(self.global_steps + 1, jnp.int32)
         found_inf_acc = self._found_inf_acc
@@ -575,6 +621,50 @@ class DeepSpeedEngine:
                 self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
                                  STEP_GLOBAL_TIMER])
 
+    def _get_offload_prep(self):
+        """Jitted device-side epilogue for the offload step: unscale + clip
+        + global norm on the (ZeRO-sharded) grad accumulator."""
+        key = "offload_prep"
+        if key not in self._compiled:
+            clip = float(self.gradient_clipping() or 0.0)
+            self._compiled[key] = jax.jit(
+                lambda grads, scale: _unscale_and_clip(grads, scale, clip),
+                donate_argnums=(0,))
+        return self._compiled[key]
+
+    def _offload_step(self, lr_kwargs=None):
+        """Host optimizer step (ZeRO-Offload): device prep -> host C++ Adam
+        -> bf16 upload (reference stage_1_and_2.py:1630 CPU Adam step +
+        :1750 updated-param gather)."""
+        grads, gnorm = self._get_offload_prep()(self._grad_acc,
+                                                self._scaler_state.scale)
+        self._last_global_grad_norm = gnorm
+        found_inf = bool(jax.device_get(self._found_inf_acc)) \
+            if self._found_inf_acc is not None else False
+        if not found_inf:
+            host_grads = [np.asarray(g) for g in jax.device_get(jax.tree.leaves(grads))]
+            bf_leaves = self._host_opt.step(host_grads, lr=self.get_lr()[0])
+            new_tree = self._host_opt.bf16_leaves_to_tree(bf_leaves)
+            if self.compute_dtype != jnp.bfloat16:
+                new_tree = jax.tree.map(
+                    lambda a: np.asarray(a, dtype=np.float32)
+                    if a.dtype.name == "bfloat16" else a, new_tree)
+            if "offload_put" not in self._compiled:
+                self._compiled["offload_put"] = jax.jit(
+                    lambda t: t, out_shardings=self._plan.param_shardings)
+            self._params = self._compiled["offload_put"](jax.tree.map(
+                lambda a, old: jnp.asarray(a, dtype=old.dtype), new_tree, self._params))
+        else:
+            self.skipped_steps += 1
+        self._scaler_state = self.loss_scaler.update(
+            self._scaler_state, jnp.asarray(found_inf))
+        self.zero_grad()
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size()
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step(**(lr_kwargs or {}))
+        self.tput_timer.stop(global_step=True)
+
     # ------------------------------------------------------------------ #
     # Fully-fused train step (scan over GAS) — the benchmark hot path
     # ------------------------------------------------------------------ #
@@ -606,13 +696,7 @@ class DeepSpeedEngine:
                     lambda p: jnp.zeros(p.shape, jnp.float32), params)
                 (acc, found_inf, _), losses = jax.lax.scan(
                     micro, (zero_acc, jnp.asarray(False), rng), batches)
-                inv = 1.0 / scaler_state.scale
-                grads = jax.tree.map(lambda g: g * inv, acc)
-                gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
-                                     for g in jax.tree.leaves(grads)))
-                if clip > 0.0:
-                    factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
-                    grads = jax.tree.map(lambda g: g * factor, grads)
+                grads, gnorm = _unscale_and_clip(acc, scaler_state.scale, clip)
                 new_params, new_opt = self.optimizer.update(grads, opt_state, params,
                                                             lr=lr, step=step)
                 keep = lambda new, old: jax.tree.map(
@@ -640,6 +724,15 @@ class DeepSpeedEngine:
         else:
             # batch already stacked [gas, micro_batch, ...]
             pass
+        if self._offload_cfg is not None:
+            # offload path: the optimizer lives on host, so the step cannot
+            # fuse into one XLA program — run the 3-call sequence per micro
+            for i in range(gas):
+                mb = jax.tree.map(lambda x: x[i], batch)
+                loss = self.forward(mb)
+                self.backward(loss)
+            self.step()
+            return self._last_loss
         self._lazy_init((jax.tree.map(lambda x: x[0], batch),), {})
         batch = self._curriculum_slice(batch, 2)
         batch = jax.tree.map(
@@ -687,6 +780,9 @@ class DeepSpeedEngine:
             "optimizer": self._opt_state,
             "loss_scaler": self._scaler_state,
         }
+        if self._host_opt is not None:
+            # streamed per-leaf .npy files — never one giant pickle
+            self._host_opt.save(os.path.join(ckpt_dir, "host_optimizer"))
         meta = {
             "global_steps": self.global_steps,
             "global_samples": self.global_samples,
@@ -726,6 +822,10 @@ class DeepSpeedEngine:
         self._params = arrays["module"]
         if load_module_only:
             return path, meta.get("client_state", {})
+        host_opt_dir = os.path.join(load_dir, str(tag), "host_optimizer")
+        if load_optimizer_states and self._host_opt is not None \
+                and os.path.isdir(host_opt_dir):
+            self._host_opt.load(host_opt_dir)
         if load_optimizer_states and arrays.get("optimizer") is not None:
             opt = arrays["optimizer"]
             if self._opt_state is not None and hasattr(self._opt_state, "_fields") \

@@ -1,0 +1,3 @@
+from deepspeed_tpu.runtime.swap_tensor.async_swapper import AsyncTensorSwapper, SwapBuffer
+from deepspeed_tpu.runtime.swap_tensor.optimizer_swapper import (
+    OptimizerSwapper, PartitionedParameterSwapper)

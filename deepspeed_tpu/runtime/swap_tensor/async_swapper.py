@@ -1,0 +1,110 @@
+"""Async tensor swapping between host RAM and NVMe.
+
+TPU-native equivalent of reference ``runtime/swap_tensor/async_swapper.py``
+(AsyncTensorSwapper) + the pinned-buffer management of
+``csrc/aio/py_lib/deepspeed_pin_tensor.cpp``: a bounded pool of reusable host
+buffers moved to/from disk by the native aio thread pool
+(``csrc/aio/aio.cpp``), so swap I/O overlaps host compute (the C++ Adam step)
+and steady-state host RAM stays at ``buffer_count × buffer_size`` regardless
+of how much state lives on NVMe.
+"""
+
+import os
+
+import numpy as np
+
+from deepspeed_tpu.ops.aio import AsyncIOHandle, AIO_DEFAULT_BLOCK_SIZE
+from deepspeed_tpu.utils.logging import logger
+
+MIN_AIO_BYTES = 1024 * 1024
+AIO_ALIGN = 4096
+
+
+class SwapBuffer:
+    """One reusable host staging buffer (fp32)."""
+
+    def __init__(self, numel):
+        self.data = np.zeros(numel, dtype=np.float32)
+        self.in_flight = False
+
+    def view(self, numel):
+        assert numel <= self.data.size
+        return self.data[:numel]
+
+
+class AsyncTensorSwapper:
+    """Move fp32 arrays host<->NVMe asynchronously with a buffer pool
+    (reference ``async_swapper.py`` AsyncTensorSwapper.swap_out_tensors)."""
+
+    def __init__(self, swap_dir, aio_handle=None, buffer_count=4,
+                 buffer_size=None, block_size=AIO_DEFAULT_BLOCK_SIZE,
+                 thread_count=4):
+        self.swap_dir = swap_dir
+        os.makedirs(swap_dir, exist_ok=True)
+        self.handle = aio_handle or AsyncIOHandle(block_size=block_size,
+                                                  thread_count=thread_count)
+        self.buffer_count = buffer_count
+        self.buffer_size = buffer_size
+        self._buffers = []
+        self._pending_writes = []
+
+    def _get_buffer(self, numel):
+        for b in self._buffers:
+            if not b.in_flight and b.data.size >= numel:
+                return b
+        if len(self._buffers) < self.buffer_count:
+            b = SwapBuffer(max(numel, self.buffer_size or 0))
+            self._buffers.append(b)
+            return b
+        # pool exhausted: drain writes and retry
+        self.synchronize_writes()
+        for b in self._buffers:
+            if not b.in_flight and b.data.size >= numel:
+                return b
+        b = SwapBuffer(max(numel, self.buffer_size or 0))
+        self._buffers.append(b)
+        return b
+
+    def path_for(self, key):
+        return os.path.join(self.swap_dir, f"{key}.swp")
+
+    def swap_out(self, key, array):
+        """Stage ``array`` into a pool buffer and start the async write."""
+        flat = np.ascontiguousarray(array, dtype=np.float32).ravel()
+        buf = self._get_buffer(flat.size)
+        np.copyto(buf.view(flat.size), flat)
+        buf.in_flight = True
+        self.handle.async_pwrite(buf.view(flat.size), self.path_for(key))
+        self._pending_writes.append(buf)
+        return self.path_for(key)
+
+    def synchronize_writes(self):
+        if self._pending_writes:
+            self.handle.wait()
+            for b in self._pending_writes:
+                b.in_flight = False
+            self._pending_writes.clear()
+
+    def swap_in(self, key, numel, out=None):
+        """Synchronous read of a swapped tensor."""
+        arr = out if out is not None else np.empty(numel, dtype=np.float32)
+        self.handle.sync_pread(arr[:numel], self.path_for(key))
+        return arr[:numel]
+
+    def start_swap_in(self, key, numel):
+        """Async prefetch into a pool buffer; returns the buffer. Call
+        ``finish_swap_ins`` before touching it (pipeline_read path,
+        reference ``pipelined_optimizer_swapper.py``)."""
+        buf = self._get_buffer(numel)
+        buf.in_flight = True
+        self.handle.async_pread(buf.view(numel), self.path_for(key))
+        return buf
+
+    def finish_swap_ins(self):
+        self.handle.wait()
+        for b in self._buffers:
+            b.in_flight = False
+
+    def release(self):
+        self.synchronize_writes()
+        self._buffers.clear()

@@ -1,0 +1,221 @@
+"""ZeRO-Offload: host-resident optimizer driven by the native C++ Adam.
+
+TPU-native equivalent of the reference's ZeRO-Offload optimizer path
+(``runtime/zero/stage_1_and_2.py:1037-1162`` CPU-offload grad copy +
+``deepspeed/ops/adam/cpu_adam.py`` step + 16-bit param copy-back, and the
+NVMe tier of ``runtime/zero/stage3.py:1637,1686`` optimizer-state swap):
+
+* device keeps only bf16 working params (HBM savings = the point of offload);
+* fp32 masters + Adam moments live in host RAM (device="cpu") or in NVMe
+  swap files with a bounded host buffer pool (device="nvme");
+* at each boundary, grads are unscaled/clipped on device (jitted), pulled to
+  host, stepped leaf-by-leaf by ``csrc/adam/cpu_adam.cpp`` (bf16 copy-out in
+  the same pass), and the bf16 leaves are shipped back to the device mesh —
+  with NVMe reads for the next leaf prefetched behind the current leaf's
+  compute (reference ``pipelined_optimizer_swapper.py``).
+"""
+
+from typing import Any, Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.adam import cpu_adam as cpu_adam_mod
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class HostOffloadedAdam:
+    """Host Adam over the param pytree, with optional NVMe state residency."""
+
+    def __init__(self, abstract_params, offload_config, lr=1e-3,
+                 betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                 adamw_mode=True, bias_correction=True):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.bias_correction = bias_correction
+        self.step_count = 0
+
+        self.nvme = offload_config.device == "nvme"
+        self.pipeline_read = bool(getattr(offload_config, "pipeline_read", False))
+        leaves, self.treedef = jax.tree.flatten(abstract_params)
+        self.shapes = [l.shape for l in leaves]
+        self.numels = [int(np.prod(l.shape)) for l in leaves]
+        self.names = [f"leaf{i}" for i in range(len(leaves))]
+
+        if self.nvme:
+            from deepspeed_tpu.runtime.swap_tensor.optimizer_swapper import \
+                OptimizerSwapper
+            swap_dir = offload_config.nvme_path or "/tmp/dstpu_nvme_swap"
+            self.swapper = OptimizerSwapper(
+                swap_dir,
+                buffer_count=getattr(offload_config, "buffer_count", 4),
+                pipeline_read=self.pipeline_read,
+                pipeline_write=bool(getattr(offload_config, "pipeline_write", False)))
+            # bounded reusable host staging: two sets of 3 state arrays —
+            # ping-pong so pipeline_read can prefetch leaf i+1 behind the
+            # compute on leaf i (reference pipelined_optimizer_swapper.py)
+            maxn = max(self.numels) if self.numels else 0
+            self._stage = [[np.zeros(maxn, np.float32) for _ in range(3)]
+                           for _ in range(2)]
+            self.masters = None
+        else:
+            self.swapper = None
+            self.masters = None  # filled by init_from_params
+        self.exp_avg = None
+        self.exp_avg_sq = None
+
+    # -------------------------------------------------------------- #
+    def init_from_params(self, params):
+        """Download device params once to seed fp32 host masters
+        (reference stage_1_and_2.py:576 partitioned fp32 master creation)."""
+        host = [np.asarray(jax.device_get(l), dtype=np.float32).ravel()
+                for l in jax.tree.leaves(params)]
+        if self.nvme:
+            for name, n, m in zip(self.names, self.numels, host):
+                self.swapper.register(name, n, m, np.zeros(n, np.float32),
+                                      np.zeros(n, np.float32))
+            log_dist(f"offloaded optimizer state for {len(host)} leaves to NVMe",
+                     ranks=[0])
+        else:
+            self.masters = host
+            self.exp_avg = [np.zeros(n, np.float32) for n in self.numels]
+            self.exp_avg_sq = [np.zeros(n, np.float32) for n in self.numels]
+
+    # -------------------------------------------------------------- #
+    def step(self, host_grads, lr=None):
+        """One Adam step over all leaves; returns list of bf16 (uint16 view)
+        flat arrays for device upload."""
+        self.step_count += 1
+        lr = float(self.lr if lr is None else lr)
+        outs = []
+        if not self.nvme:
+            for i, g in enumerate(host_grads):
+                bf = np.empty(self.numels[i], np.uint16)
+                cpu_adam_mod.adam_step(
+                    self.masters[i], self.exp_avg[i], self.exp_avg_sq[i],
+                    np.ascontiguousarray(g, np.float32).ravel(),
+                    lr, self.beta1, self.beta2, self.eps, self.weight_decay,
+                    self.adamw_mode, self.bias_correction, self.step_count,
+                    bf16_out=bf)
+                outs.append(bf)
+            return outs
+
+        # NVMe path: ping-pong staging — with pipeline_read the next leaf's
+        # state streams in behind the current leaf's C++ Adam compute
+        # (reference pipelined_optimizer_swapper.py); writes drain lazily
+        # unless pipeline_write=False (the swapper enforces that).
+        n_leaves = len(host_grads)
+        if self.pipeline_read and n_leaves > 1:
+            self.swapper.start_swap_in(self.names[0], self._stage[0])
+            self.swapper.finish_swap_ins()
+        for i, g in enumerate(host_grads):
+            n = self.numels[i]
+            cur = self._stage[i % 2]
+            if self.pipeline_read and n_leaves > 1:
+                if i + 1 < n_leaves:   # prefetch next behind this compute
+                    self.swapper.start_swap_in(self.names[i + 1],
+                                               self._stage[(i + 1) % 2])
+            else:
+                self.swapper.swap_in(self.names[i], *cur)
+            bf = np.empty(n, np.uint16)
+            cpu_adam_mod.adam_step(
+                cur[0][:n], cur[1][:n], cur[2][:n],
+                np.ascontiguousarray(g, np.float32).ravel(),
+                lr, self.beta1, self.beta2, self.eps, self.weight_decay,
+                self.adamw_mode, self.bias_correction, self.step_count,
+                bf16_out=bf)
+            self.swapper.swap_out(self.names[i], *cur)
+            if self.pipeline_read and n_leaves > 1 and i + 1 < n_leaves:
+                self.swapper.finish_swap_ins()
+            outs.append(bf)
+        self.swapper.drain()
+        return outs
+
+    # -------------------------------------------------------------- #
+    def _iter_states(self):
+        """Yield (index, master, exp_avg, exp_avg_sq) leaf by leaf, with
+        NVMe reads streamed through one staging set so peak host RAM stays
+        one leaf regardless of model size."""
+        if not self.nvme:
+            for i in range(len(self.names)):
+                yield i, self.masters[i], self.exp_avg[i], self.exp_avg_sq[i]
+            return
+        for i, (name, n) in enumerate(zip(self.names, self.numels)):
+            m = np.empty(n, np.float32)
+            a = np.empty(n, np.float32)
+            v = np.empty(n, np.float32)
+            self.swapper.swap_in(name, m, a, v)
+            yield i, m, a, v
+
+    def save(self, ckpt_dir):
+        """Stream state to per-leaf .npy files (never pickles the whole
+        model; reference _save_zero_checkpoint per-rank files,
+        engine.py:3220)."""
+        import os
+        os.makedirs(ckpt_dir, exist_ok=True)
+        np.save(os.path.join(ckpt_dir, "step.npy"), np.int64(self.step_count))
+        for i, m, a, v in self._iter_states():
+            np.save(os.path.join(ckpt_dir, f"leaf{i}.master.npy"), m)
+            np.save(os.path.join(ckpt_dir, f"leaf{i}.exp_avg.npy"), a)
+            np.save(os.path.join(ckpt_dir, f"leaf{i}.exp_avg_sq.npy"), v)
+
+    def load(self, ckpt_dir):
+        import os
+        self.step_count = int(np.load(os.path.join(ckpt_dir, "step.npy")))
+        for i, (name, n) in enumerate(zip(self.names, self.numels)):
+            m = np.ascontiguousarray(
+                np.load(os.path.join(ckpt_dir, f"leaf{i}.master.npy")), np.float32)
+            a = np.ascontiguousarray(
+                np.load(os.path.join(ckpt_dir, f"leaf{i}.exp_avg.npy")), np.float32)
+            v = np.ascontiguousarray(
+                np.load(os.path.join(ckpt_dir, f"leaf{i}.exp_avg_sq.npy")), np.float32)
+            if self.nvme:
+                if name in self.swapper.groups:
+                    self.swapper.swap_out(name, m, a, v)
+                else:
+                    self.swapper.register(name, n, m, a, v)
+            else:
+                self.masters[i], self.exp_avg[i], self.exp_avg_sq[i] = m, a, v
+        if self.nvme:
+            self.swapper.drain()
+
+    # kept for programmatic access (tests, universal checkpoint)
+    def state_dict(self) -> Dict[str, Any]:
+        ms, avs, vs = [], [], []
+        for _, m, a, v in self._iter_states():
+            ms.append(m); avs.append(a); vs.append(v)
+        return {"step": self.step_count,
+                "masters": ms, "exp_avg": avs, "exp_avg_sq": vs}
+
+    def load_state_dict(self, sd):
+        self.step_count = int(sd["step"])
+        ms = [np.ascontiguousarray(a, np.float32).ravel() for a in sd["masters"]]
+        avs = [np.ascontiguousarray(a, np.float32).ravel() for a in sd["exp_avg"]]
+        vs = [np.ascontiguousarray(a, np.float32).ravel() for a in sd["exp_avg_sq"]]
+        if self.nvme:
+            for name, n, m, a, v in zip(self.names, self.numels, ms, avs, vs):
+                if name in self.swapper.groups:
+                    self.swapper.swap_out(name, m, a, v)
+                else:
+                    self.swapper.register(name, n, m, a, v)
+            self.swapper.drain()
+        else:
+            self.masters, self.exp_avg, self.exp_avg_sq = ms, avs, vs
+
+    def master_params_tree(self):
+        """fp32 masters as the original pytree (zero_to_fp32 path)."""
+        ms = [m.copy() for _, m, _, _ in self._iter_states()]
+        return jax.tree.unflatten(
+            self.treedef,
+            [m.reshape(s) for m, s in zip(ms, self.shapes)])
+
+    def bf16_leaves_to_tree(self, bf_leaves):
+        import ml_dtypes
+        arrs = [b.view(ml_dtypes.bfloat16).reshape(s)
+                for b, s in zip(bf_leaves, self.shapes)]
+        return jax.tree.unflatten(self.treedef, arrs)

@@ -102,41 +102,4 @@ void ds_adagrad_step(float* params,
     }
 }
 
-// Fused host LAMB trust-ratio step on a single shard (two-pass: caller
-// supplies per-shard param/update norms pre-reduced across shards).
-void ds_lamb_apply(float* params,
-                   const float* update,  // m_hat/denom + wd*p, precomputed
-                   int64_t n,
-                   float lr,
-                   float trust_ratio,
-                   uint16_t* bf16_out) {
-#if defined(_OPENMP)
-#pragma omp parallel for schedule(static)
-#endif
-    for (int64_t i = 0; i < n; ++i) {
-        float p = params[i] - lr * trust_ratio * update[i];
-        params[i] = p;
-        if (bf16_out) bf16_out[i] = float_to_bf16(p);
-    }
-}
-
-// fp32 <- bf16 widening copy (device download path).
-void ds_bf16_to_fp32(const uint16_t* src, float* dst, int64_t n) {
-#if defined(_OPENMP)
-#pragma omp parallel for schedule(static)
-#endif
-    for (int64_t i = 0; i < n; ++i) {
-        uint32_t x = static_cast<uint32_t>(src[i]) << 16;
-        std::memcpy(&dst[i], &x, sizeof(float));
-    }
-}
-
-int ds_adam_num_threads(void) {
-#if defined(_OPENMP)
-    return omp_get_max_threads();
-#else
-    return 1;
-#endif
-}
-
 }  // extern "C"

@@ -1,0 +1,9 @@
+"""Compression-aware training (reference ``deepspeed/compression/``):
+QAT weight/activation quantization, sparse/row/head/channel pruning,
+layer-reduction distillation — as pure transforms over flax param pytrees."""
+
+from .compress import (CompressionSpec, apply_compression, init_compression,
+                       quant_act, redundancy_clean, student_initialization)
+from .config import get_compression_config, get_layer_reduction_config
+from .scheduler import compression_scheduler
+from . import constants

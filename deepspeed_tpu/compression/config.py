@@ -1,0 +1,90 @@
+"""Parse the ``compression_training`` config block.
+
+Same JSON schema as the reference (``deepspeed/compression/config.py``):
+each technique has ``shared_parameters`` plus named ``different_groups``
+entries carrying per-group ``params``, ``modules`` scope, and
+``related_modules``.
+"""
+
+from . import constants as C
+
+_TECH_DEFAULT_SHARED = {
+    C.WEIGHT_QUANTIZATION: {
+        C.TECHNIQUE_ENABLED: False,
+        C.WEIGHT_QUANTIZE_KERNEL: False,
+        C.TECHNIQUE_SCHEDULE_OFFSET: 0,
+        C.WEIGHT_QUANTIZE_GROUPS: 1,
+        C.WEIGHT_QUANTIZE_VERBOSE: False,
+        C.WEIGHT_QUANTIZE_TYPE: C.WEIGHT_QUANTIZE_SYMMETRIC,
+        C.WEIGHT_QUANTIZE_IN_FORWARD_ENABLED: False,
+        C.WEIGHT_QUANTIZE_ROUNDING: C.WEIGHT_QUANTIZE_NEAREST_ROUNDING,
+        C.WEIGHT_QUANTIZE_FP16_MIXED_QUANTIZE: {
+            C.TECHNIQUE_ENABLED: False,
+            C.WEIGHT_QUANTIZE_CHANGE_RATIO: 0.001,
+        },
+    },
+    C.ACTIVATION_QUANTIZATION: {
+        C.TECHNIQUE_ENABLED: False,
+        C.ACTIVATION_QUANTIZE_TYPE: C.WEIGHT_QUANTIZE_SYMMETRIC,
+        C.ACTIVATION_QUANTIZE_RANGE: C.ACTIVATION_QUANTIZE_RANGE_DYNAMIC,
+        C.TECHNIQUE_SCHEDULE_OFFSET: 1000,
+    },
+    C.SPARSE_PRUNING: {
+        C.TECHNIQUE_ENABLED: False,
+        C.SPARSE_PRUNING_METHOD: C.SPARSE_PRUNING_METHOD_L1,
+        C.TECHNIQUE_SCHEDULE_OFFSET: 1000,
+    },
+    C.ROW_PRUNING: {
+        C.TECHNIQUE_ENABLED: False,
+        C.ROW_PRUNING_METHOD: C.SPARSE_PRUNING_METHOD_L1,
+        C.TECHNIQUE_SCHEDULE_OFFSET: 1000,
+    },
+    C.HEAD_PRUNING: {
+        C.TECHNIQUE_ENABLED: False,
+        C.HEAD_PRUNING_METHOD: C.SPARSE_PRUNING_METHOD_TOPK,
+        C.TECHNIQUE_SCHEDULE_OFFSET: 1000,
+    },
+    C.CHANNEL_PRUNING: {
+        C.TECHNIQUE_ENABLED: False,
+        C.CHANNEL_PRUNING_METHOD: C.SPARSE_PRUNING_METHOD_L1,
+        C.TECHNIQUE_SCHEDULE_OFFSET: 1000,
+    },
+}
+
+
+def get_layer_reduction_config(ds_config):
+    block = (ds_config or {}).get(C.COMPRESSION_TRAINING, {})
+    lr = dict(block.get(C.LAYER_REDUCTION, {}))
+    lr.setdefault(C.LAYER_REDUCTION_ENABLED, False)
+    return lr
+
+
+def get_compression_config(ds_config):
+    """→ {technique: {'shared_parameters': {...}, 'different_groups':
+    {group_name: {'params': {...}, 'modules': [...], 'related_modules': [...]}}}}
+    with defaults filled (reference ``config.py get_compression_config``)."""
+    block = (ds_config or {}).get(C.COMPRESSION_TRAINING, {})
+    out = {}
+    for tech, defaults in _TECH_DEFAULT_SHARED.items():
+        tc = block.get(tech, {})
+        shared = dict(defaults)
+        shared.update(tc.get(C.SHARED_PARAMETERS, {}))
+        groups = {}
+        for gname, gcfg in tc.get(C.DIFFERENT_GROUPS, {}).items():
+            params = dict(gcfg.get(C.DIFFERENT_GROUPS_PARAMETERS, {}))
+            modules = gcfg.get(C.DIFFERENT_GROUPS_MODULE_SCOPE,
+                               C.DIFFERENT_GROUPS_MODULE_SCOPE_DEFAULT)
+            if isinstance(modules, str):
+                modules = [modules]
+            related = gcfg.get(C.DIFFERENT_GROUPS_RELATED_MODULE_SCOPE,
+                               C.DIFFERENT_GROUPS_RELATED_MODULE_SCOPE_DEFAULT)
+            groups[gname] = {
+                C.DIFFERENT_GROUPS_PARAMETERS: params,
+                C.DIFFERENT_GROUPS_MODULE_SCOPE: modules,
+                C.DIFFERENT_GROUPS_RELATED_MODULE_SCOPE: related,
+            }
+        if shared.get(C.TECHNIQUE_ENABLED) and not groups:
+            raise ValueError(
+                f"compression technique {tech} enabled but no different_groups")
+        out[tech] = {C.SHARED_PARAMETERS: shared, C.DIFFERENT_GROUPS: groups}
+    return out

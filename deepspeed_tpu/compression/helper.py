@@ -1,0 +1,99 @@
+"""Pytree-path utilities for the compression subsystem.
+
+The reference walks ``model.named_modules()`` and swaps layers in place
+(``deepspeed/compression/helper.py:45 module_replacement``).  TPU-natively a
+model is a flax param pytree; a "module" is a subtree whose leaves are
+``kernel``/``bias``/``embedding``.  We address modules by '/'-joined paths and
+match the config's scope patterns against them.
+"""
+
+import fnmatch
+
+import jax
+
+
+LEAF_NAMES = ("kernel", "bias", "embedding", "scale")
+
+
+def flatten_params(params):
+    """dict {'a/b/kernel': leaf} preserving insertion order."""
+    flat = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(prefix + (k,), v)
+        else:
+            flat["/".join(prefix)] = node
+
+    walk((), params)
+    return flat
+
+
+def unflatten_params(flat):
+    root = {}
+    for path, leaf in flat.items():
+        keys = path.split("/")
+        node = root
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node[keys[-1]] = leaf
+    return root
+
+
+def module_paths(params):
+    """Paths of 'modules': parents of kernel/embedding leaves."""
+    mods = []
+    for path in flatten_params(params):
+        keys = path.split("/")
+        if keys[-1] in ("kernel", "embedding") and len(keys) > 1:
+            mod = "/".join(keys[:-1])
+            if mod not in mods:
+                mods.append(mod)
+    return mods
+
+
+def match_module_scope(pattern, paths):
+    """Reference ``compress.py:25 get_module_name``: a scope entry matches by
+    wildcard or substring.  Patterns may use '.' or '/' separators."""
+    pattern = pattern.replace(".", "/")
+    if any(c in pattern for c in "*?["):
+        return [p for p in paths if fnmatch.fnmatch(p, pattern)
+                or fnmatch.fnmatch(p, "*" + pattern)
+                or fnmatch.fnmatch(p, "*" + pattern + "*")]
+    return [p for p in paths if pattern in p]
+
+
+def get_by_path(params, path):
+    node = params
+    for k in path.split("/"):
+        node = node[k]
+    return node
+
+
+def set_by_path(params, path, value):
+    """Functional set: returns a new tree (shares unmodified subtrees)."""
+    keys = path.split("/")
+
+    def rec(node, i):
+        new = dict(node)
+        if i == len(keys) - 1:
+            new[keys[i]] = value
+        else:
+            new[keys[i]] = rec(node[keys[i]], i + 1)
+        return new
+
+    return rec(params, 0)
+
+
+def module_weight_path(params, mod_path):
+    """The main weight leaf of a module (kernel or embedding)."""
+    node = get_by_path(params, mod_path)
+    for name in ("kernel", "embedding"):
+        if isinstance(node, dict) and name in node:
+            return mod_path + "/" + name
+    raise KeyError(f"no weight leaf under {mod_path}")
+
+
+def tree_size(params):
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,38 @@
+"""Compression scheduler (reference ``deepspeed/compression/scheduler.py:12``
+``compression_scheduler``): tracks the training step and reports which
+techniques are live, so the engine can pass the right static step into
+``apply_compression`` and log activation transitions."""
+
+from deepspeed_tpu.utils.logging import logger
+from . import constants as C
+
+
+class compression_scheduler:
+
+    def __init__(self, spec, ds_config=None):
+        self.spec = spec
+        self.training_steps = 0
+        self._announced = set()
+
+    def check_all(self):
+        """Log every technique whose schedule_offset has just been reached
+        (the analog of the reference flipping ``*_enabled`` module flags)."""
+        for mod, techs in self.spec.bindings.items():
+            for tech, gp in techs.items():
+                offset = int(gp.get(C.TECHNIQUE_SCHEDULE_OFFSET, 0))
+                key = (mod, tech)
+                if self.training_steps >= offset and key not in self._announced:
+                    self._announced.add(key)
+                    logger.info(f"compression: {tech} active on {mod} "
+                                f"at step {self.training_steps}")
+
+    def step(self, step_zero_check=False):
+        if not step_zero_check:
+            self.training_steps += 1
+        self.check_all()
+
+    def is_active(self, mod, tech):
+        gp = self.spec.techniques(mod).get(tech)
+        if gp is None:
+            return False
+        return self.training_steps >= int(gp.get(C.TECHNIQUE_SCHEDULE_OFFSET, 0))

@@ -75,14 +75,20 @@ def jit_build(name, sources, extra_flags=(), want_openmp=True):
     with _lock:
         if os.path.exists(out):
             return out
-        tmp = out + ".tmp"
+        # per-process temp name: concurrent ranks may race to build the same
+        # op; each compiles privately and os.replace publishes atomically
+        tmp = out + f".tmp.{os.getpid()}"
         last_err = None
-        for flags in flag_sets:
-            last_err = _try_compile(tmp, sources, flags)
-            if last_err is None:
-                os.replace(tmp, out)
-                logger.info(f"built native op {name} ({' '.join(flags)})")
-                return out
+        try:
+            for flags in flag_sets:
+                last_err = _try_compile(tmp, sources, flags)
+                if last_err is None:
+                    os.replace(tmp, out)
+                    logger.info(f"built native op {name} ({' '.join(flags)})")
+                    return out
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
         raise RuntimeError(f"failed to build native op {name}:\n{last_err}")
 
 

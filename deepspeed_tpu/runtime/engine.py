@@ -149,6 +149,14 @@ class DeepSpeedEngine:
         self._host_opt = None
 
         self.optimizer = self.client_optimizer or build_optimizer(self._config.optimizer)
+        if self._offload_cfg is not None and self.optimizer is not None and \
+                "adam" not in type(self.optimizer).__name__.lower():
+            # the host kernel implements Adam/AdamW only — replacing a
+            # non-Adam optimizer silently would change the training
+            # trajectory (reference validates the offload optimizer)
+            raise ValueError(
+                "zero_optimization.offload_optimizer requires an Adam-family "
+                f"optimizer, got {type(self.optimizer).__name__}")
         self.lr_scheduler = self.client_lr_scheduler or build_lr_scheduler(
             self._config.scheduler, self.optimizer)
         self.loss_scaler = create_loss_scaler(self._config.fp16)
@@ -643,12 +651,13 @@ class DeepSpeedEngine:
             if self._found_inf_acc is not None else False
         if not found_inf:
             host_grads = [np.asarray(g) for g in jax.device_get(jax.tree.leaves(grads))]
-            bf_leaves = self._host_opt.step(host_grads, lr=self.get_lr()[0])
-            new_tree = self._host_opt.bf16_leaves_to_tree(bf_leaves)
-            if self.compute_dtype != jnp.bfloat16:
-                new_tree = jax.tree.map(
-                    lambda a: np.asarray(a, dtype=np.float32)
-                    if a.dtype.name == "bfloat16" else a, new_tree)
+            # fp32 compute must upload the fp32 masters directly — rounding
+            # working params through bf16 every step would silently degrade
+            # full-precision training
+            want_fp32 = self.compute_dtype != jnp.bfloat16
+            leaves = self._host_opt.step(host_grads, lr=self.get_lr()[0],
+                                         fp32_out=want_fp32)
+            new_tree = self._host_opt.leaves_to_tree(leaves)
             if "offload_put" not in self._compiled:
                 self._compiled["offload_put"] = jax.jit(
                     lambda t: t, out_shardings=self._plan.param_shardings)
@@ -727,11 +736,15 @@ class DeepSpeedEngine:
         if self._offload_cfg is not None:
             # offload path: the optimizer lives on host, so the step cannot
             # fuse into one XLA program — run the 3-call sequence per micro
+            micro_losses = []
             for i in range(gas):
                 mb = jax.tree.map(lambda x: x[i], batch)
                 loss = self.forward(mb)
                 self.backward(loss)
+                micro_losses.append(loss)
             self.step()
+            # match the fused path's metric: mean over the global batch
+            self._last_loss = jnp.mean(jnp.stack(micro_losses))
             return self._last_loss
         self._lazy_init((jax.tree.map(lambda x: x[0], batch),), {})
         batch = self._curriculum_slice(batch, 2)
@@ -823,9 +836,14 @@ class DeepSpeedEngine:
         if load_module_only:
             return path, meta.get("client_state", {})
         host_opt_dir = os.path.join(load_dir, str(tag), "host_optimizer")
-        if load_optimizer_states and self._host_opt is not None \
-                and os.path.isdir(host_opt_dir):
-            self._host_opt.load(host_opt_dir)
+        if self._host_opt is not None:
+            if load_optimizer_states and os.path.isdir(host_opt_dir):
+                self._host_opt.load(host_opt_dir)
+            else:
+                # no host states loaded: re-seed fp32 masters from the loaded
+                # params, else the next step() would run Adam on stale masters
+                # and silently overwrite the checkpoint's weights
+                self._host_opt.init_from_params(self._params)
         if load_optimizer_states and arrays.get("optimizer") is not None:
             opt = arrays["optimizer"]
             if self._opt_state is not None and hasattr(self._opt_state, "_fields") \

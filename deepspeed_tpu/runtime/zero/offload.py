@@ -15,6 +15,7 @@ NVMe tier of ``runtime/zero/stage3.py:1637,1686`` optimizer-state swap):
   compute (reference ``pipelined_optimizer_swapper.py``).
 """
 
+import os
 from typing import Any, Dict
 
 import numpy as np
@@ -23,11 +24,14 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.ops.adam import cpu_adam as cpu_adam_mod
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
 from deepspeed_tpu.utils.logging import log_dist
 
 
 class HostOffloadedAdam:
     """Host Adam over the param pytree, with optional NVMe state residency."""
+
+    _instances = 0  # per-process engine counter for swap-dir namespacing
 
     def __init__(self, abstract_params, offload_config, lr=1e-3,
                  betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
@@ -50,7 +54,20 @@ class HostOffloadedAdam:
         if self.nvme:
             from deepspeed_tpu.runtime.swap_tensor.optimizer_swapper import \
                 OptimizerSwapper
-            swap_dir = offload_config.nvme_path or "/tmp/dstpu_nvme_swap"
+            base = offload_config.nvme_path or "/tmp/dstpu_nvme_swap"
+            # namespace by process identity + per-process instance counter:
+            # two jobs, ranks, or engines sharing an nvme_path must not
+            # clobber each other's swap files (the reference namespaces swap
+            # paths by rank); the dir is torn down at exit — swap files are
+            # runtime state only (checkpoints go through save()/load())
+            HostOffloadedAdam._instances += 1
+            swap_dir = os.path.join(
+                base, f"rank{jax.process_index()}_pid{os.getpid()}"
+                      f"_e{HostOffloadedAdam._instances}")
+            self._swap_dir = swap_dir
+            import atexit
+            import shutil
+            atexit.register(shutil.rmtree, swap_dir, ignore_errors=True)
             self.swapper = OptimizerSwapper(
                 swap_dir,
                 buffer_count=getattr(offload_config, "buffer_count", 4),
@@ -62,48 +79,53 @@ class HostOffloadedAdam:
             maxn = max(self.numels) if self.numels else 0
             self._stage = [[np.zeros(maxn, np.float32) for _ in range(3)]
                            for _ in range(2)]
-            self.masters = None
+            self.cpu_opt = None
         else:
             self.swapper = None
-            self.masters = None  # filled by init_from_params
-        self.exp_avg = None
-        self.exp_avg_sq = None
+            # CPU path delegates to the public host optimizer (single
+            # implementation of the per-shard loop; reference
+            # deepspeed/ops/adam/cpu_adam.py DeepSpeedCPUAdam)
+            self.cpu_opt = None  # built by init_from_params
 
     # -------------------------------------------------------------- #
     def init_from_params(self, params):
         """Download device params once to seed fp32 host masters
-        (reference stage_1_and_2.py:576 partitioned fp32 master creation)."""
-        host = [np.asarray(jax.device_get(l), dtype=np.float32).ravel()
-                for l in jax.tree.leaves(params)]
+        (reference stage_1_and_2.py:576 partitioned fp32 master creation).
+        NVMe path streams leaf-by-leaf so peak host RAM stays one leaf."""
         if self.nvme:
-            for name, n, m in zip(self.names, self.numels, host):
+            for name, n, leaf in zip(self.names, self.numels,
+                                     jax.tree.leaves(params)):
+                m = np.asarray(jax.device_get(leaf), dtype=np.float32).ravel()
                 self.swapper.register(name, n, m, np.zeros(n, np.float32),
                                       np.zeros(n, np.float32))
-            log_dist(f"offloaded optimizer state for {len(host)} leaves to NVMe",
-                     ranks=[0])
+                del m
+            self.swapper.drain()
+            log_dist(f"offloaded optimizer state for {len(self.names)} leaves "
+                     f"to NVMe", ranks=[0])
         else:
-            self.masters = host
-            self.exp_avg = [np.zeros(n, np.float32) for n in self.numels]
-            self.exp_avg_sq = [np.zeros(n, np.float32) for n in self.numels]
+            host = [np.ascontiguousarray(
+                        np.asarray(jax.device_get(l), dtype=np.float32).ravel())
+                    for l in jax.tree.leaves(params)]
+            self.cpu_opt = DeepSpeedCPUAdam(
+                host, lr=self.lr, betas=(self.beta1, self.beta2), eps=self.eps,
+                weight_decay=self.weight_decay, adamw_mode=self.adamw_mode,
+                bias_correction=self.bias_correction)
 
     # -------------------------------------------------------------- #
-    def step(self, host_grads, lr=None):
-        """One Adam step over all leaves; returns list of bf16 (uint16 view)
-        flat arrays for device upload."""
+    def step(self, host_grads, lr=None, fp32_out=False):
+        """One Adam step over all leaves.  Returns flat per-leaf arrays for
+        the device upload: bf16 (uint16 view) by default, or the updated
+        fp32 masters when ``fp32_out`` (fp32-compute training must not round
+        working params through bf16)."""
         self.step_count += 1
         lr = float(self.lr if lr is None else lr)
         outs = []
         if not self.nvme:
-            for i, g in enumerate(host_grads):
-                bf = np.empty(self.numels[i], np.uint16)
-                cpu_adam_mod.adam_step(
-                    self.masters[i], self.exp_avg[i], self.exp_avg_sq[i],
-                    np.ascontiguousarray(g, np.float32).ravel(),
-                    lr, self.beta1, self.beta2, self.eps, self.weight_decay,
-                    self.adamw_mode, self.bias_correction, self.step_count,
-                    bf16_out=bf)
-                outs.append(bf)
-            return outs
+            bf_outs = None if fp32_out else \
+                [np.empty(n, np.uint16) for n in self.numels]
+            self.cpu_opt.step(host_grads, bf16_outs=bf_outs, lr=lr)
+            self.step_count = self.cpu_opt.step_count
+            return self.cpu_opt.params if fp32_out else bf_outs
 
         # NVMe path: ping-pong staging — with pipeline_read the next leaf's
         # state streams in behind the current leaf's C++ Adam compute
@@ -122,7 +144,7 @@ class HostOffloadedAdam:
                                                self._stage[(i + 1) % 2])
             else:
                 self.swapper.swap_in(self.names[i], *cur)
-            bf = np.empty(n, np.uint16)
+            bf = None if fp32_out else np.empty(n, np.uint16)
             cpu_adam_mod.adam_step(
                 cur[0][:n], cur[1][:n], cur[2][:n],
                 np.ascontiguousarray(g, np.float32).ravel(),
@@ -132,9 +154,19 @@ class HostOffloadedAdam:
             self.swapper.swap_out(self.names[i], *cur)
             if self.pipeline_read and n_leaves > 1 and i + 1 < n_leaves:
                 self.swapper.finish_swap_ins()
-            outs.append(bf)
+            # staging buffers are reused next leaf — fp32 upload needs a copy
+            outs.append(cur[0][:n].copy() if fp32_out else bf)
         self.swapper.drain()
         return outs
+
+    @property
+    def masters(self):
+        """fp32 master shards (CPU residency only; NVMe states live in swap
+        files — use ``_iter_states``/``master_params_tree``)."""
+        if self.nvme:
+            raise AttributeError("masters are NVMe-resident; use "
+                                 "master_params_tree()")
+        return self.cpu_opt.params
 
     # -------------------------------------------------------------- #
     def _iter_states(self):
@@ -143,7 +175,8 @@ class HostOffloadedAdam:
         one leaf regardless of model size."""
         if not self.nvme:
             for i in range(len(self.names)):
-                yield i, self.masters[i], self.exp_avg[i], self.exp_avg_sq[i]
+                yield (i, self.cpu_opt.params[i], self.cpu_opt.exp_avg[i],
+                       self.cpu_opt.exp_avg_sq[i])
             return
         for i, (name, n) in enumerate(zip(self.names, self.numels)):
             m = np.empty(n, np.float32)
@@ -180,9 +213,13 @@ class HostOffloadedAdam:
                 else:
                     self.swapper.register(name, n, m, a, v)
             else:
-                self.masters[i], self.exp_avg[i], self.exp_avg_sq[i] = m, a, v
+                self.cpu_opt.params[i] = m
+                self.cpu_opt.exp_avg[i] = a
+                self.cpu_opt.exp_avg_sq[i] = v
         if self.nvme:
             self.swapper.drain()
+        else:
+            self.cpu_opt.step_count = self.step_count
 
     # kept for programmatic access (tests, universal checkpoint)
     def state_dict(self) -> Dict[str, Any]:
@@ -205,7 +242,10 @@ class HostOffloadedAdam:
                     self.swapper.register(name, n, m, a, v)
             self.swapper.drain()
         else:
-            self.masters, self.exp_avg, self.exp_avg_sq = ms, avs, vs
+            self.cpu_opt.params = ms
+            self.cpu_opt.exp_avg = avs
+            self.cpu_opt.exp_avg_sq = vs
+            self.cpu_opt.step_count = self.step_count
 
     def master_params_tree(self):
         """fp32 masters as the original pytree (zero_to_fp32 path)."""
@@ -219,3 +259,11 @@ class HostOffloadedAdam:
         arrs = [b.view(ml_dtypes.bfloat16).reshape(s)
                 for b, s in zip(bf_leaves, self.shapes)]
         return jax.tree.unflatten(self.treedef, arrs)
+
+    def leaves_to_tree(self, leaves):
+        """Flat per-leaf step() outputs -> param pytree.  uint16 leaves are
+        bf16 views; fp32 leaves pass through (fp32_out path)."""
+        if leaves and leaves[0].dtype == np.uint16:
+            return self.bf16_leaves_to_tree(leaves)
+        return jax.tree.unflatten(
+            self.treedef, [a.reshape(s) for a, s in zip(leaves, self.shapes)])

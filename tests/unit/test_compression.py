@@ -1,0 +1,245 @@
+"""Compression subsystem tests — the analog of the reference's
+``tests/unit/compression/test_compression.py``: config parsing, QAT
+fake-quant behavior, pruning mask semantics, redundancy_clean dim
+reduction with forward equivalence, and student init."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.compression import (apply_compression, init_compression,
+                                       quant_act, redundancy_clean,
+                                       student_initialization,
+                                       get_compression_config,
+                                       compression_scheduler)
+from deepspeed_tpu.compression import constants as C
+
+
+def _mlp_params(key=0, din=16, dh=32, dout=16):
+    rng = np.random.default_rng(key)
+    return {
+        "fc1": {"kernel": jnp.asarray(rng.normal(size=(din, dh)), jnp.float32),
+                "bias": jnp.zeros((dh,), jnp.float32)},
+        "fc2": {"kernel": jnp.asarray(rng.normal(size=(dh, dout)), jnp.float32),
+                "bias": jnp.zeros((dout,), jnp.float32)},
+    }
+
+
+def _mlp_fwd(params, x):
+    h = jnp.maximum(x @ params["fc1"]["kernel"] + params["fc1"]["bias"], 0)
+    return h @ params["fc2"]["kernel"] + params["fc2"]["bias"]
+
+
+def _wq_config(start_bits=8, target_bits=8, offset=0, period=1,
+               modules=("fc1",)):
+    return {
+        "compression_training": {
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True,
+                                      "quantize_groups": 1},
+                "different_groups": {
+                    "wq1": {"params": {"start_bits": start_bits,
+                                       "target_bits": target_bits,
+                                       "quantization_period": period,
+                                       "schedule_offset": offset},
+                            "modules": list(modules)}
+                }
+            }
+        }
+    }
+
+
+class TestConfig:
+
+    def test_defaults_filled(self):
+        cfg = get_compression_config(_wq_config())
+        shared = cfg[C.WEIGHT_QUANTIZATION][C.SHARED_PARAMETERS]
+        assert shared[C.TECHNIQUE_ENABLED]
+        assert shared[C.WEIGHT_QUANTIZE_TYPE] == "symmetric"
+        assert not cfg[C.SPARSE_PRUNING][C.SHARED_PARAMETERS][C.TECHNIQUE_ENABLED]
+
+    def test_enabled_without_groups_raises(self):
+        bad = {"compression_training": {"sparse_pruning": {
+            "shared_parameters": {"enabled": True}}}}
+        with pytest.raises(ValueError):
+            get_compression_config(bad)
+
+
+class TestWeightQuantization:
+
+    def test_fake_quant_applied_and_close(self):
+        params = _mlp_params()
+        spec = init_compression(params, _wq_config())
+        viewed = apply_compression(params, spec, step=0)
+        w0, w1 = params["fc1"]["kernel"], viewed["fc1"]["kernel"]
+        assert not np.allclose(w0, w1)                 # actually quantized
+        assert np.max(np.abs(np.asarray(w0 - w1))) < 0.1   # 8-bit is close
+        # fc2 untouched
+        assert np.allclose(params["fc2"]["kernel"], viewed["fc2"]["kernel"])
+
+    def test_bit_shedding_schedule(self):
+        params = _mlp_params()
+        cfg = _wq_config(start_bits=12, target_bits=4, offset=10, period=5)
+        spec = init_compression(params, cfg)
+        before = apply_compression(params, spec, step=5)
+        assert np.allclose(before["fc1"]["kernel"], params["fc1"]["kernel"],
+                           atol=1e-3)  # 12 bits ~ lossless at this scale
+        later = apply_compression(params, spec, step=10 + 5 * 8)
+        err4 = np.max(np.abs(np.asarray(later["fc1"]["kernel"] -
+                                        params["fc1"]["kernel"])))
+        assert err4 > 0.01  # shed down to 4 bits → visible error
+
+    def test_ste_gradient_flows(self):
+        params = _mlp_params()
+        spec = init_compression(params, _wq_config())
+        x = jnp.ones((2, 16))
+
+        def loss(p):
+            return jnp.sum(_mlp_fwd(apply_compression(p, spec, 0), x) ** 2)
+
+        g = jax.grad(loss)(params)
+        assert np.isfinite(np.asarray(g["fc1"]["kernel"])).all()
+        assert np.abs(np.asarray(g["fc1"]["kernel"])).sum() > 0
+
+
+class TestPruning:
+
+    def test_sparse_pruning_ratio(self):
+        params = _mlp_params()
+        cfg = {"compression_training": {"sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                                  "method": "l1"},
+            "different_groups": {"sp1": {"params": {"dense_ratio": 0.25},
+                                         "modules": ["fc1"]}}}}}
+        spec = init_compression(params, cfg)
+        viewed = apply_compression(params, spec, step=0)
+        nz = np.count_nonzero(np.asarray(viewed["fc1"]["kernel"]))
+        total = viewed["fc1"]["kernel"].size
+        assert nz == pytest.approx(0.25 * total, rel=0.05)
+        # keeps the largest-magnitude entries
+        kept = np.abs(np.asarray(params["fc1"]["kernel"]))[
+            np.asarray(viewed["fc1"]["kernel"]) != 0]
+        dropped = np.abs(np.asarray(params["fc1"]["kernel"]))[
+            np.asarray(viewed["fc1"]["kernel"]) == 0]
+        assert kept.min() >= dropped.max() - 1e-6
+
+    def test_schedule_offset_gates_pruning(self):
+        params = _mlp_params()
+        cfg = {"compression_training": {"sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 100},
+            "different_groups": {"sp1": {"params": {"dense_ratio": 0.5,
+                                                    "schedule_offset": 100},
+                                         "modules": ["fc1"]}}}}}
+        spec = init_compression(params, cfg)
+        early = apply_compression(params, spec, step=50)
+        assert np.allclose(early["fc1"]["kernel"], params["fc1"]["kernel"])
+
+    def _row_cfg(self, ratio=0.5):
+        return {"compression_training": {"row_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"rp1": {"params": {"dense_ratio": ratio,
+                                                    "schedule_offset": 0},
+                                         "modules": ["fc1"],
+                                         "related_modules": [["fc2"]]}}}}}
+
+    def test_row_pruning_masks_and_related(self):
+        params = _mlp_params()
+        spec = init_compression(params, self._row_cfg())
+        viewed = apply_compression(params, spec, step=0)
+        col_norms = np.abs(np.asarray(viewed["fc1"]["kernel"])).sum(axis=0)
+        assert (col_norms == 0).sum() == 16  # half of 32 outputs zeroed
+        # related fc2 input rows zeroed consistently
+        row_norms = np.abs(np.asarray(viewed["fc2"]["kernel"])).sum(axis=1)
+        assert ((col_norms == 0) == (row_norms == 0)).all()
+
+    def test_redundancy_clean_shrinks_and_preserves_forward(self):
+        params = _mlp_params()
+        spec = init_compression(params, self._row_cfg())
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 16)),
+                        jnp.float32)
+        masked_out = _mlp_fwd(apply_compression(params, spec, 0), x)
+        cleaned = redundancy_clean(params, spec)
+        assert cleaned["fc1"]["kernel"].shape == (16, 16)
+        assert cleaned["fc2"]["kernel"].shape == (16, 16)
+        clean_out = _mlp_fwd(cleaned, x)
+        np.testing.assert_allclose(np.asarray(masked_out),
+                                   np.asarray(clean_out), atol=1e-5)
+
+    def test_head_pruning(self):
+        rng = np.random.default_rng(2)
+        nh, hd, d = 4, 8, 32
+        params = {
+            "attn": {
+                "q_proj": {"kernel": jnp.asarray(rng.normal(size=(d, d)),
+                                                 jnp.float32)},
+                "o_proj": {"kernel": jnp.asarray(rng.normal(size=(d, d)),
+                                                 jnp.float32)},
+            }
+        }
+        cfg = {"compression_training": {"head_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"hp1": {
+                "params": {"dense_ratio": 0.5, "num_heads": nh,
+                           "schedule_offset": 0},
+                "modules": ["o_proj"],
+                "related_modules": [["q_proj"]]}}}}}
+        spec = init_compression(params, cfg)
+        viewed = apply_compression(params, spec, step=0)
+        w = np.asarray(viewed["attn"]["o_proj"]["kernel"]).reshape(nh, hd, d)
+        zero_heads = [h for h in range(nh) if np.abs(w[h]).sum() == 0]
+        assert len(zero_heads) == 2
+        cleaned = redundancy_clean(params, spec)
+        assert cleaned["attn"]["o_proj"]["kernel"].shape == (d // 2, d)
+        assert cleaned["attn"]["q_proj"]["kernel"].shape == (d, d // 2)
+
+
+class TestActivationQuant:
+
+    def test_quant_act_ste(self):
+        x = jnp.linspace(-1, 1, 64)
+        q = quant_act(x, bits=4)
+        assert not np.allclose(q, x)
+        assert len(np.unique(np.round(np.asarray(q), 6))) <= 17
+        g = jax.grad(lambda y: jnp.sum(quant_act(y, bits=4) ** 2))(x)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(2 * q), atol=1e-5)
+
+
+class TestSchedulerAndStudentInit:
+
+    def test_scheduler_activation(self):
+        params = _mlp_params()
+        cfg = _wq_config(offset=3)
+        spec = init_compression(params, cfg)
+        sched = compression_scheduler(spec, cfg)
+        assert not sched.is_active("fc1", C.WEIGHT_QUANTIZATION)
+        for _ in range(3):
+            sched.step()
+        assert sched.is_active("fc1", C.WEIGHT_QUANTIZATION)
+
+    def test_student_initialization(self):
+        rng = np.random.default_rng(3)
+
+        def layers(n):
+            return {f"layers_{i}": {"fc": {"kernel": jnp.asarray(
+                rng.normal(size=(4, 4)), jnp.float32)}} for i in range(n)}
+
+        teacher = {**layers(6), "embed": {"embedding": jnp.asarray(
+            rng.normal(size=(10, 4)), jnp.float32)}}
+        student = {**{k: jax.tree_util.tree_map(jnp.zeros_like, v)
+                      for k, v in layers(3).items()},
+                   "embed": {"embedding": jnp.zeros((10, 4), jnp.float32)}}
+        cfg = {"compression_training": {"layer_reduction": {
+            "enabled": True, "keep_number_layer": 3,
+            "module_name_prefix": "layers",
+            "teacher_layer": [1, 3, 5],
+            "other_module_name": ["embed"]}}}
+        out = student_initialization(student, teacher, cfg)
+        np.testing.assert_array_equal(
+            np.asarray(out["layers_0"]["fc"]["kernel"]),
+            np.asarray(teacher["layers_1"]["fc"]["kernel"]))
+        np.testing.assert_array_equal(
+            np.asarray(out["layers_2"]["fc"]["kernel"]),
+            np.asarray(teacher["layers_5"]["fc"]["kernel"]))
+        np.testing.assert_array_equal(np.asarray(out["embed"]["embedding"]),
+                                      np.asarray(teacher["embed"]["embedding"]))

@@ -228,3 +228,22 @@ def test_offload_checkpoint_roundtrip(tmp_path):
     assert engine._host_opt.step_count == step_before
     for a, b in zip(engine._host_opt.masters, masters_before):
         np.testing.assert_array_equal(a, b)
+
+
+def test_offload_load_without_opt_states_reseeds_masters(tmp_path):
+    """Loading a checkpoint without host optimizer states must re-seed the
+    fp32 masters from the loaded params — otherwise the next step() runs
+    Adam over stale masters and silently reverts the model."""
+    engine, *_ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=_offload_config("cpu"))
+    _train(engine, 3)
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    trained = [np.asarray(jax.device_get(l), np.float32).ravel()
+               for l in jax.tree.leaves(engine.params)]
+    _train(engine, 2)
+    engine.load_checkpoint(str(tmp_path / "ckpt"), load_optimizer_states=False)
+    for m, p in zip(engine._host_opt.masters, trained):
+        np.testing.assert_allclose(m, p, rtol=1e-2, atol=1e-2)  # bf16 params
+    # and one more step keeps training near the loaded point, not init
+    loss = _train(engine, 1)
+    assert np.isfinite(loss[-1])

@@ -1,0 +1,2 @@
+from deepspeed_tpu.autotuning.autotuner import Autotuner, autotune  # noqa: F401
+from deepspeed_tpu.autotuning.scheduler import Experiment, ResourceManager  # noqa: F401

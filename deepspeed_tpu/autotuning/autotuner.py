@@ -1,0 +1,240 @@
+"""Autotuner — finds the fastest DeepSpeed config for a model on this mesh.
+
+Reference: ``deepspeed/autotuning/autotuner.py:42`` (``Autotuner``): a
+model-info profile run (``:664``), ZeRO-stage tuning spaces (``:524``), and a
+per-stage micro-batch sweep (``:741``), executed through a ResourceManager
+and a grid/random/model-based tuner.
+
+TPU-native redesign: experiments are re-jitted programs on the same mesh,
+not launcher jobs.  The tuning space is pruned twice before anything runs —
+an analytic ZeRO memory model first, then XLA's compile-time
+``memory_analysis()`` (exact on TPU) — so OOM candidates cost a compile at
+most, never a crash.  Measurements run the engine's real fused train step.
+"""
+
+import gc
+import json
+import os
+import time
+
+import numpy as np
+
+from deepspeed_tpu.autotuning import constants as C
+from deepspeed_tpu.autotuning.cost_model import (device_memory_limit,
+                                                 estimate_zero_memory)
+from deepspeed_tpu.autotuning.scheduler import Experiment, ResourceManager
+from deepspeed_tpu.autotuning.tuner import (GridSearchTuner, ModelBasedTuner,
+                                            RandomTuner)
+from deepspeed_tpu.autotuning.utils import (dict_deep_update, memory_to_string,
+                                            number_to_string, powers_of_two,
+                                            resize_batch)
+from deepspeed_tpu.utils.logging import logger
+
+
+class Autotuner:
+    """Sweep (zero stage × micro-batch size) on the live mesh and return the
+    fastest config (reference ``Autotuner.tune``)."""
+
+    def __init__(self,
+                 model,
+                 config,
+                 sample_batch,
+                 activation_bytes_per_sample=0,
+                 measure_steps=None,
+                 warmup_steps=None,
+                 zero_stages=None):
+        import deepspeed_tpu
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+        self.model = model
+        self.base_config = dict(config)
+        self.sample_batch = sample_batch
+        self.activation_bytes_per_sample = activation_bytes_per_sample
+        self._ds = deepspeed_tpu
+
+        parsed = DeepSpeedConfig(dict(config))
+        self.at_cfg = parsed.autotuning_config
+        self.metric = self.at_cfg.metric
+        self.warmup_steps = (warmup_steps if warmup_steps is not None
+                             else self.at_cfg.start_profile_step)
+        self.measure_steps = (measure_steps if measure_steps is not None
+                              else max(1, self.at_cfg.end_profile_step
+                                       - self.at_cfg.start_profile_step))
+        self.zero_stages = zero_stages
+        self.results_dir = self.at_cfg.results_dir
+        self.exps_dir = self.at_cfg.exps_dir
+        self.rm = ResourceManager(self._run_experiment, exps_dir=self.exps_dir)
+        self.best_exp = None
+        self.best_metric_val = None
+        self._model_info = None
+
+    # ------------------------------------------------------------------ #
+    def model_info(self):
+        """Parameter count/bytes from abstract init — the reference's
+        model-info profile run (``autotuner.py:664``) without executing."""
+        if self._model_info is None:
+            import jax
+            mb = resize_batch(self.sample_batch, 1)
+            abstract = jax.eval_shape(
+                lambda r, b: self.model.init(r, b), jax.random.key(0), mb)
+            leaves = jax.tree.leaves(abstract)
+            num_params = int(sum(np.prod(l.shape) for l in leaves))
+            param_bytes = int(sum(np.prod(l.shape) * l.dtype.itemsize for l in leaves))
+            self._model_info = {C.MODEL_INFO_NUM_PARAMS: num_params,
+                                C.MODEL_INFO_PARAM_BYTES: param_bytes}
+            logger.info(f"Autotuning model info: "
+                        f"{number_to_string(num_params)} params "
+                        f"({memory_to_string(param_bytes)})")
+        return self._model_info
+
+    # ------------------------------------------------------------------ #
+    def _candidate_micro_batches(self):
+        import jax
+        lo = self.at_cfg.min_train_batch_size
+        hi = self.at_cfg.max_train_batch_size or max(
+            C.DEFAULT_TUNING_MICRO_BATCH_SIZES)
+        cands = powers_of_two(lo, hi)
+        n = self.at_cfg.num_tuning_micro_batch_sizes
+        if len(cands) > n:
+            # keep the largest n — big micro-batches dominate MXU utilization
+            cands = cands[-n:]
+        return cands
+
+    def _generate_experiments(self):
+        """Build the pruned tuning space (reference ``:524``)."""
+        import jax
+        info = self.model_info()
+        dp = jax.device_count()
+        limit = device_memory_limit()
+        stages = self.zero_stages
+        if stages is None:
+            pinned = self.base_config.get("zero_optimization", {}).get("stage")
+            stages = [pinned] if pinned is not None else [0, 1, 2, 3]
+            if self.at_cfg.fast and pinned is None:
+                stages = [0, 3]  # fast mode: the two ends of the memory/comm tradeoff
+        exps = []
+        for stage in stages:
+            for mbs in self._candidate_micro_batches():
+                est = estimate_zero_memory(
+                    info[C.MODEL_INFO_NUM_PARAMS], dp, stage, mbs,
+                    self.activation_bytes_per_sample)
+                if est > limit:
+                    logger.info(
+                        f"Pruning z{stage}_mbs{mbs}: estimated "
+                        f"{memory_to_string(est)} > limit {memory_to_string(limit)}")
+                    continue
+                overrides = {
+                    "zero_optimization": {"stage": stage},
+                    "train_micro_batch_size_per_gpu": mbs,
+                }
+                # keep the global batch triple consistent: drop any pinned
+                # train_batch_size and let gas×mbs×dp define it
+                cfg = dict_deep_update(self.base_config, overrides)
+                cfg.pop("train_batch_size", None)
+                cfg.setdefault("gradient_accumulation_steps", 1)
+                cfg.get("autotuning", {}).pop("enabled", None) if isinstance(
+                    cfg.get("autotuning"), dict) else None
+                exps.append(Experiment(f"z{stage}_mbs{mbs}", cfg))
+        return exps
+
+    # ------------------------------------------------------------------ #
+    def _run_experiment(self, exp):
+        """Measure one candidate on the real fused train step."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = dict(exp.config)
+        cfg.setdefault("autotuning", {})
+        if isinstance(cfg["autotuning"], dict):
+            cfg["autotuning"]["enabled"] = False
+        engine, *_ = self._ds.initialize(model=self.model, config=cfg)
+        try:
+            mbs = engine.train_micro_batch_size_per_gpu()
+            gas = engine.gradient_accumulation_steps()
+            # micro-batch is per-chip; the engine takes the global micro batch
+            micro = resize_batch(self.sample_batch, mbs * jax.device_count())
+            batch = jax.tree.map(
+                lambda x: np.broadcast_to(x, (gas,) + x.shape).copy(), micro)
+            loss = None
+            for _ in range(self.warmup_steps):
+                loss = engine.train_batch(batch=batch)
+            if loss is not None:
+                jax.block_until_ready(loss)
+            t0 = time.perf_counter()
+            for _ in range(self.measure_steps):
+                loss = engine.train_batch(batch=batch)
+            jax.block_until_ready(loss)
+            dt = time.perf_counter() - t0
+            latency = dt / self.measure_steps
+            throughput = engine.train_batch_size() / latency
+            return {
+                C.AUTOTUNING_METRIC_LATENCY: latency,
+                C.AUTOTUNING_METRIC_THROUGHPUT: throughput,
+                "train_batch_size": engine.train_batch_size(),
+                "train_micro_batch_size_per_gpu": mbs,
+                "zero_stage": engine.zero_optimization_stage(),
+            }
+        finally:
+            del engine
+            gc.collect()
+
+    # ------------------------------------------------------------------ #
+    def _build_tuner(self, exps):
+        t = self.at_cfg.tuner_type
+        if t == C.AUTOTUNING_TUNER_RANDOM:
+            return RandomTuner(exps, self.rm, self.metric)
+        if t == C.AUTOTUNING_TUNER_MODELBASED:
+            return ModelBasedTuner(exps, self.rm, self.metric)
+        return GridSearchTuner(exps, self.rm, self.metric)
+
+    def tune(self):
+        """Run the sweep; returns the best full config dict (the artifact the
+        reference writes as ``ds_config_optimal.json``)."""
+        exps = self._generate_experiments()
+        if not exps:
+            logger.warning("Autotuning space is empty after memory pruning")
+            return None
+        logger.info(f"Autotuning over {len(exps)} candidate configs: "
+                    + ", ".join(e.name for e in exps))
+        tuner = self._build_tuner(exps)
+        self.best_exp, self.best_metric_val = tuner.tune(
+            sample_size=1,
+            n_trials=self.at_cfg.tuner_num_trials,
+            early_stopping=self.at_cfg.tuner_early_stopping)
+        self._write_results()
+        return self.best_exp.config if self.best_exp else None
+
+    # ------------------------------------------------------------------ #
+    def get_best_config(self):
+        return self.best_exp.config if self.best_exp else None
+
+    def print_tuning_results(self):
+        for exp in self.rm.finished_experiments:
+            val = exp.results.get(self.metric)
+            logger.info(f"  {exp.name}: {self.metric}="
+                        f"{val if val is not None else 'FAILED: ' + str(exp.error)}")
+        if self.best_exp:
+            logger.info(f"Best: {self.best_exp.name} "
+                        f"({self.metric}={self.best_metric_val:.3f})")
+
+    def _write_results(self):
+        os.makedirs(self.results_dir, exist_ok=True)
+        summary = {
+            "model_info": self.model_info(),
+            "metric": self.metric,
+            "best_exp": self.best_exp.to_dict() if self.best_exp else None,
+            "experiments": [e.to_dict() for e in self.rm.finished_experiments],
+        }
+        with open(os.path.join(self.results_dir, "summary.json"), "w") as f:
+            json.dump(summary, f, indent=2, default=str)
+        if self.best_exp:
+            with open(os.path.join(self.results_dir, "ds_config_optimal.json"), "w") as f:
+                json.dump(self.best_exp.config, f, indent=2, default=str)
+
+
+def autotune(model, config, sample_batch, **kwargs):
+    """One-call convenience: returns the best config dict."""
+    tuner = Autotuner(model, config, sample_batch, **kwargs)
+    best = tuner.tune()
+    tuner.print_tuning_results()
+    return best

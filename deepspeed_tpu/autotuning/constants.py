@@ -1,0 +1,24 @@
+"""Autotuning constants (reference ``deepspeed/autotuning/constants.py``)."""
+
+AUTOTUNING = "autotuning"
+AUTOTUNING_METRIC_LATENCY = "latency"
+AUTOTUNING_METRIC_THROUGHPUT = "throughput"
+AUTOTUNING_METRIC_FLOPS = "flops"
+
+# Tuner types (reference autotuning/constants.py GRIDSEARCH/RANDOM/MODEL_BASED)
+AUTOTUNING_TUNER_GRIDSEARCH = "gridsearch"
+AUTOTUNING_TUNER_RANDOM = "random"
+AUTOTUNING_TUNER_MODELBASED = "model_based"
+
+# Keys a tuning experiment may override in the DeepSpeed config.
+TUNABLE_MICRO_BATCH = "train_micro_batch_size_per_gpu"
+TUNABLE_GAS = "gradient_accumulation_steps"
+TUNABLE_ZERO_STAGE = "zero_stage"
+TUNABLE_REMAT = "remat"
+
+DEFAULT_HBM_BYTES = 16 * (1 << 30)  # v5e-class chip if memory_stats() is mute
+DEFAULT_TUNING_MICRO_BATCH_SIZES = (1, 2, 4, 8, 16, 32, 64)
+
+MODEL_INFO_KEY = "model_info"
+MODEL_INFO_NUM_PARAMS = "num_params"
+MODEL_INFO_PARAM_BYTES = "param_bytes"

@@ -1,0 +1,60 @@
+"""Experiment scheduler (reference ``deepspeed/autotuning/scheduler.py:33``
+``ResourceManager``).
+
+The reference fans experiments out over multi-node GPU slots via the
+launcher.  On TPU an experiment is a fresh jitted program on the same
+mesh, so the manager runs candidates sequentially in-process — each run
+re-jits with the candidate's config, which is exactly the isolation the
+reference gets from separate processes (XLA programs share nothing but the
+device).
+"""
+
+import json
+import os
+import traceback
+
+
+class Experiment:
+    """One tuning trial: a full DeepSpeed config + results."""
+
+    _next_id = 0
+
+    def __init__(self, name, config):
+        self.exp_id = Experiment._next_id
+        Experiment._next_id += 1
+        self.name = name
+        self.config = config
+        self.results = {}
+        self.error = None
+
+    def to_dict(self):
+        return {"exp_id": self.exp_id, "name": self.name, "config": self.config,
+                "results": self.results, "error": self.error}
+
+
+class ResourceManager:
+    """Runs experiments through a caller-supplied ``run_fn(exp) -> dict`` and
+    persists each result under ``exps_dir`` (reference ResourceManager
+    ``schedule_experiments``/``run_job``)."""
+
+    def __init__(self, run_fn, exps_dir=None):
+        self.run_fn = run_fn
+        self.exps_dir = exps_dir
+        self.finished_experiments = []
+        if exps_dir:
+            os.makedirs(exps_dir, exist_ok=True)
+
+    def schedule_experiments(self, exps):
+        for exp in exps:
+            try:
+                exp.results = self.run_fn(exp) or {}
+            except Exception as e:  # an OOM/compile failure is a data point
+                exp.error = f"{type(e).__name__}: {e}"
+                exp.results = {}
+                traceback.print_exc()
+            self.finished_experiments.append(exp)
+            if self.exps_dir:
+                path = os.path.join(self.exps_dir, f"exp_{exp.exp_id}_{exp.name}.json")
+                with open(path, "w") as f:
+                    json.dump(exp.to_dict(), f, indent=2, default=str)
+        return exps

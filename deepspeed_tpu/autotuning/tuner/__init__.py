@@ -1,0 +1,4 @@
+from deepspeed_tpu.autotuning.tuner.base_tuner import BaseTuner  # noqa: F401
+from deepspeed_tpu.autotuning.tuner.index_based_tuner import (  # noqa: F401
+    GridSearchTuner, RandomTuner)
+from deepspeed_tpu.autotuning.tuner.model_based_tuner import ModelBasedTuner  # noqa: F401

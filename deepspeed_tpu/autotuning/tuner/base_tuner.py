@@ -1,0 +1,57 @@
+"""Tuner strategy base (reference ``deepspeed/autotuning/tuner/base_tuner.py``)."""
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class BaseTuner:
+    """Iterates a list of experiments, tracking the best metric seen and
+    stopping early after ``early_stopping`` non-improving trials (reference
+    ``BaseTuner.tune``)."""
+
+    def __init__(self, exps, resource_manager, metric="throughput"):
+        self.all_exps = list(exps)
+        self.rm = resource_manager
+        self.metric = metric
+        # latency is minimized; throughput/flops maximized
+        self.maximize = metric != "latency"
+        self.best_iter = 0
+        self.best_exp = None
+        self.best_metric_val = None
+
+    def _better(self, val):
+        if self.best_metric_val is None:
+            return True
+        return val > self.best_metric_val if self.maximize \
+            else val < self.best_metric_val
+
+    def has_next(self):
+        return len(self.all_exps) > 0
+
+    def next_batch(self, sample_size=1):
+        batch = self.all_exps[:sample_size]
+        self.all_exps = self.all_exps[sample_size:]
+        return batch
+
+    def update(self):
+        """Consume results of the batch just run; subclasses that model the
+        space (ModelBasedTuner) refit here."""
+
+    def tune(self, sample_size=1, n_trials=50, early_stopping=None):
+        i = 0
+        while i < n_trials and self.has_next():
+            sampled = self.next_batch(sample_size)
+            exps = self.rm.schedule_experiments(sampled)
+            for exp in exps:
+                metric_val = exp.results.get(self.metric)
+                if metric_val is not None and self._better(metric_val):
+                    self.best_exp = exp
+                    self.best_metric_val = metric_val
+                    self.best_iter = i
+                i += 1
+            self.update()
+            if early_stopping and i >= self.best_iter + early_stopping:
+                logger.info(
+                    f"Tuner early-stopped at trial {i} "
+                    f"(no improvement in {early_stopping} trials)")
+                break
+        return self.best_exp, self.best_metric_val

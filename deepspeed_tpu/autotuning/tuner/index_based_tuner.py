@@ -1,0 +1,18 @@
+"""Grid / random tuners (reference ``tuner/index_based_tuner.py``)."""
+
+import random
+
+from deepspeed_tpu.autotuning.tuner.base_tuner import BaseTuner
+
+
+class GridSearchTuner(BaseTuner):
+    """Enumerate the space in order (reference GridSearchTuner)."""
+
+
+class RandomTuner(BaseTuner):
+    """Shuffled enumeration (reference RandomTuner)."""
+
+    def __init__(self, exps, resource_manager, metric="throughput", seed=0):
+        super().__init__(exps, resource_manager, metric)
+        rng = random.Random(seed)
+        rng.shuffle(self.all_exps)

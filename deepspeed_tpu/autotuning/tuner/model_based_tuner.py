@@ -1,0 +1,82 @@
+"""Model-based tuner: surrogate-cost-model guided search.
+
+The reference fits an XGBoost regressor over explored configs and picks the
+unexplored config with the best predicted metric
+(``deepspeed/autotuning/tuner/model_based_tuner.py``, ``tuner/cost_model.py``).
+xgboost isn't in this image, so the surrogate is a ridge-regularized
+least-squares model over simple config features — the same
+explore-then-exploit loop, dependency-free.
+"""
+
+import numpy as np
+
+from deepspeed_tpu.autotuning.tuner.base_tuner import BaseTuner
+
+
+def _featurize(exp):
+    cfg = exp.config
+    mbs = cfg.get("train_micro_batch_size_per_gpu", 1) or 1
+    gas = cfg.get("gradient_accumulation_steps", 1) or 1
+    stage = cfg.get("zero_optimization", {}).get("stage", 0)
+    remat = 1.0 if cfg.get("activation_checkpointing", {}).get(
+        "partition_activations", False) else 0.0
+    return np.array([1.0, np.log2(mbs), float(stage), np.log2(gas), remat])
+
+
+class XGBoostCostModel:
+    """Ridge-regression surrogate with the reference cost model's fit/predict
+    surface (``tuner/cost_model.py:XGBoostCostModel``)."""
+
+    def __init__(self, loss_type="reg", num_threads=None, log_interval=25,
+                 upper_model=None):
+        self.w = None
+
+    def fit(self, xs, ys):
+        X = np.stack(xs)
+        y = np.asarray(ys, dtype=np.float64)
+        lam = 1e-3
+        A = X.T @ X + lam * np.eye(X.shape[1])
+        self.w = np.linalg.solve(A, X.T @ y)
+
+    def predict(self, xs):
+        X = np.stack(xs)
+        if self.w is None:
+            return np.zeros(X.shape[0])
+        return X @ self.w
+
+
+class ModelBasedTuner(BaseTuner):
+    """Explore ``warmup`` random configs, then repeatedly run the config the
+    surrogate predicts best (reference ModelBasedTuner.find_estimated_top_configs)."""
+
+    def __init__(self, exps, resource_manager, metric="throughput", warmup=3):
+        super().__init__(exps, resource_manager, metric)
+        self.warmup = warmup
+        self.cost_model = XGBoostCostModel()
+        self.evaluated_feats = []
+        self.evaluated_metrics = []
+        self._ran = 0
+
+    def next_batch(self, sample_size=1):
+        if self._ran < self.warmup or not self.evaluated_feats:
+            batch = self.all_exps[:sample_size]
+            self.all_exps = self.all_exps[sample_size:]
+        else:
+            preds = self.cost_model.predict([_featurize(e) for e in self.all_exps])
+            order = np.argsort(-preds if self.maximize else preds)[:sample_size]
+            batch = [self.all_exps[i] for i in order]
+            for e in batch:
+                self.all_exps.remove(e)
+        self._ran += len(batch)
+        return batch
+
+    def update(self):
+        self.evaluated_feats = []
+        self.evaluated_metrics = []
+        for exp in self.rm.finished_experiments:
+            val = exp.results.get(self.metric)
+            if val is not None:
+                self.evaluated_feats.append(_featurize(exp))
+                self.evaluated_metrics.append(val)
+        if len(self.evaluated_feats) >= 2:
+            self.cost_model.fit(self.evaluated_feats, self.evaluated_metrics)

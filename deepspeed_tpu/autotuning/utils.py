@@ -1,0 +1,51 @@
+"""Autotuning helpers (reference ``deepspeed/autotuning/utils.py``)."""
+
+import copy
+
+import numpy as np
+
+
+def memory_to_string(n, precision=2):
+    for unit, div in (("TB", 1 << 40), ("GB", 1 << 30), ("MB", 1 << 20), ("KB", 1 << 10)):
+        if n >= div:
+            return f"{n / div:.{precision}f}{unit}"
+    return f"{int(n)}B"
+
+
+def number_to_string(n):
+    for unit, div in (("T", 1e12), ("B", 1e9), ("M", 1e6), ("K", 1e3)):
+        if n >= div:
+            return f"{n / div:.2f}{unit}"
+    return str(int(n))
+
+
+def dict_deep_update(base, overrides):
+    """Recursive dict merge returning a new dict (experiment-config builder)."""
+    out = copy.deepcopy(base)
+    for k, v in overrides.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = dict_deep_update(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+def resize_batch(sample_batch, micro_batch_size):
+    """Build a micro-batch of the requested size by tiling a sample batch's
+    leading dimension (the autotuner's synthetic-data generator)."""
+    import jax
+
+    def rsz(x):
+        x = np.asarray(x)
+        return np.resize(x, (micro_batch_size,) + x.shape[1:])
+
+    return jax.tree.map(rsz, sample_batch)
+
+
+def powers_of_two(lo, hi):
+    out, v = [], 1
+    while v <= hi:
+        if v >= lo:
+            out.append(v)
+        v *= 2
+    return out

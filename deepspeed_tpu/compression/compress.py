@@ -106,8 +106,15 @@ def init_compression(params, ds_config, teacher_params=None, mpu=None):
                         if isinstance(rpat_list, str):
                             rpat_list = [rpat_list]
                         for rpat in rpat_list:
-                            rel += [r for r in match_module_scope(rpat, mods)
-                                    if _same_block(m, r)]
+                            cands = match_module_scope(rpat, mods)
+                            if not cands:
+                                continue
+                            # pair with the match(es) sharing the deepest
+                            # common ancestor — e.g. layer_0/intermediate/dense
+                            # pairs with layer_0/output/dense, not layer_1's
+                            best = max(_common_depth(m, r) for r in cands)
+                            rel += [r for r in cands
+                                    if _common_depth(m, r) == best]
                 gparams = dict(g[C.DIFFERENT_GROUPS_PARAMETERS])
                 gparams.setdefault(C.TECHNIQUE_SCHEDULE_OFFSET,
                                    shared.get(C.TECHNIQUE_SCHEDULE_OFFSET, 0))
@@ -119,11 +126,17 @@ def init_compression(params, ds_config, teacher_params=None, mpu=None):
     return spec
 
 
-def _same_block(mod, other):
-    """Related modules must live under the same parent (e.g. ``attn/o_proj``
-    pairs with ``attn/q_proj``, not with ``mlp/fc``)."""
+def _common_depth(mod, other):
+    """Number of leading path segments two module paths share (how close two
+    modules sit in the tree — used to pair each pruned module with *its*
+    layer's related modules)."""
     a, b = mod.split("/"), other.split("/")
-    return a[:-1] == b[:-1]
+    n = 0
+    for x, y in zip(a[:-1], b[:-1]):
+        if x != y:
+            break
+        n += 1
+    return n
 
 
 def _score_mask(spec, params, mod, tech, gparams):

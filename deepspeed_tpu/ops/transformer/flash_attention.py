@@ -29,6 +29,10 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
+# LSE/delta row vectors are stored with a broadcast 128-lane trailing dim so
+# every Pallas block is (sublane, lane)-tileable on real TPU Mosaic (same
+# layout trick as jax's reference TPU flash kernel's l/m tensors).
+LSE_LANES = 128
 
 
 def _interpret():
@@ -105,7 +109,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l = l_scr[:, 0:1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_scr[:, 0] + jnp.log(safe_l[:, 0]))
+        # LSE rides a 128-lane trailing dim: Mosaic requires output block
+        # shapes tiled (8, 128) on the last two dims, so a [block_q]-shaped
+        # row per (b, h) cannot be written directly
+        lse_ref[0, 0] = jnp.broadcast_to(m_scr[:, 0:1] + jnp.log(safe_l),
+                                         lse_ref.shape[2:])
 
 
 def _fwd(q, k, v, scale, causal, block_q, block_k):
@@ -135,11 +143,12 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), q_map),
-            pl.BlockSpec((1, 1, block_q), lambda bh, iq, ik: (bh // H, bh % H, iq)),
+            pl.BlockSpec((1, 1, block_q, LSE_LANES),
+                         lambda bh, iq, ik: (bh // H, bh % H, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, S), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, S, LSE_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -171,8 +180,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]                 # [bq, 1]
-        delta = delta_ref[0, 0][:, None]             # [bq, 1]
+        lse = lse_ref[0, 0][:, 0:1]                  # [bq, 1]
+        delta = delta_ref[0, 0][:, 0:1]              # [bq, 1]
         kv_rows = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
                                                           (block_k, 1), 0)
         valid_kv = kv_rows < kv_len
@@ -219,8 +228,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0][:, 0:1]
+        delta = delta_ref[0, 0][:, 0:1]
         q_rows = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
                                                          (block_q, 1), 0)
         valid_q = q_rows < q_len
@@ -263,7 +272,10 @@ def _bwd(scale, causal, block_q, block_k, res, do):
     nq = pl.cdiv(S, block_q)
     nk = pl.cdiv(Sk, block_k)
 
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                axis=-1)[..., None],
+        lse.shape)
 
     def q_map(bh, iq, ik):
         return (bh // H, bh % H, iq, 0)
@@ -272,7 +284,7 @@ def _bwd(scale, causal, block_q, block_k, res, do):
         return (bh // H, (bh % H) * KVH // H, ik, 0)
 
     def lse_map(bh, iq, ik):
-        return (bh // H, bh % H, iq)
+        return (bh // H, bh % H, iq, 0)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
@@ -283,8 +295,8 @@ def _bwd(scale, causal, block_q, block_k, res, do):
             pl.BlockSpec((1, 1, block_k, D), kv_map),
             pl.BlockSpec((1, 1, block_k, D), kv_map),
             pl.BlockSpec((1, 1, block_q, D), q_map),
-            pl.BlockSpec((1, 1, block_q), lse_map),
-            pl.BlockSpec((1, 1, block_q), lse_map),
+            pl.BlockSpec((1, 1, block_q, LSE_LANES), lse_map),
+            pl.BlockSpec((1, 1, block_q, LSE_LANES), lse_map),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D), q_map),
         out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
@@ -303,7 +315,7 @@ def _bwd(scale, causal, block_q, block_k, res, do):
         return (bh // H, (bh % H) * KVH // H, ik, 0)
 
     def lse_map2(bh, ik, iq):
-        return (bh // H, bh % H, iq)
+        return (bh // H, bh % H, iq, 0)
 
     dk_full, dv_full = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
@@ -314,8 +326,8 @@ def _bwd(scale, causal, block_q, block_k, res, do):
             pl.BlockSpec((1, 1, block_k, D), kv_map2),
             pl.BlockSpec((1, 1, block_k, D), kv_map2),
             pl.BlockSpec((1, 1, block_q, D), q_map2),
-            pl.BlockSpec((1, 1, block_q), lse_map2),
-            pl.BlockSpec((1, 1, block_q), lse_map2),
+            pl.BlockSpec((1, 1, block_q, LSE_LANES), lse_map2),
+            pl.BlockSpec((1, 1, block_q, LSE_LANES), lse_map2),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, D), kv_out_map),

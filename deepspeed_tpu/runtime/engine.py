@@ -834,6 +834,10 @@ class DeepSpeedEngine:
         arrays, meta = self.checkpoint_engine.load(path, abstract_arrays=abstract)
         self._params = arrays["module"]
         if load_module_only:
+            if self._host_opt is not None:
+                # fresh masters from the loaded weights — stale fp32 masters
+                # would overwrite them on the next offload step
+                self._host_opt.init_from_params(self._params)
             return path, meta.get("client_state", {})
         host_opt_dir = os.path.join(load_dir, str(tag), "host_optimizer")
         if self._host_opt is not None:

@@ -119,6 +119,8 @@ class HostOffloadedAdam:
         working params through bf16)."""
         self.step_count += 1
         lr = float(self.lr if lr is None else lr)
+        # optimizer state is flat per leaf; grads may arrive leaf-shaped
+        host_grads = [np.ascontiguousarray(g).ravel() for g in host_grads]
         outs = []
         if not self.nvme:
             bf_outs = None if fp32_out else \

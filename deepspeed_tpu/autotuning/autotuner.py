@@ -21,7 +21,9 @@ import numpy as np
 
 from deepspeed_tpu.autotuning import constants as C
 from deepspeed_tpu.autotuning.cost_model import (device_memory_limit,
-                                                 estimate_zero_memory)
+                                                 estimate_zero_memory,
+                                                 xla_flops_analysis,
+                                                 xla_memory_analysis)
 from deepspeed_tpu.autotuning.scheduler import Experiment, ResourceManager
 from deepspeed_tpu.autotuning.tuner import (GridSearchTuner, ModelBasedTuner,
                                             RandomTuner)
@@ -67,6 +69,7 @@ class Autotuner:
         self.best_exp = None
         self.best_metric_val = None
         self._model_info = None
+        self._precheck_cache = {}
 
     # ------------------------------------------------------------------ #
     def model_info(self):
@@ -89,10 +92,17 @@ class Autotuner:
 
     # ------------------------------------------------------------------ #
     def _candidate_micro_batches(self):
+        """Per-chip micro-batch candidates.  The config's
+        min/max_train_batch_size bound the GLOBAL batch (mbs × gas × chips,
+        same semantics as the batch triple in runtime/config.py), so divide
+        by the world size and accumulation steps."""
         import jax
-        lo = self.at_cfg.min_train_batch_size
-        hi = self.at_cfg.max_train_batch_size or max(
-            C.DEFAULT_TUNING_MICRO_BATCH_SIZES)
+        denom = jax.device_count() * int(
+            self.base_config.get("gradient_accumulation_steps", 1) or 1)
+        lo = max(1, -(-self.at_cfg.min_train_batch_size // denom))
+        hi_global = self.at_cfg.max_train_batch_size
+        hi = (max(1, hi_global // denom) if hi_global
+              else max(C.DEFAULT_TUNING_MICRO_BATCH_SIZES))
         cands = powers_of_two(lo, hi)
         n = self.at_cfg.num_tuning_micro_batch_sizes
         if len(cands) > n:
@@ -132,12 +142,36 @@ class Autotuner:
                 cfg = dict_deep_update(self.base_config, overrides)
                 cfg.pop("train_batch_size", None)
                 cfg.setdefault("gradient_accumulation_steps", 1)
-                cfg.get("autotuning", {}).pop("enabled", None) if isinstance(
-                    cfg.get("autotuning"), dict) else None
                 exps.append(Experiment(f"z{stage}_mbs{mbs}", cfg))
         return exps
 
     # ------------------------------------------------------------------ #
+    def _compile_precheck(self, mbs):
+        """AOT-compile the forward loss at this micro-batch and consult XLA's
+        exact memory/flops analysis (no execution).  Forward memory is a
+        lower bound on train-step memory, so exceeding the budget here is a
+        sound prune; returns the fwd flop count for the FLOPS metric."""
+        import jax
+        if mbs in self._precheck_cache:
+            return self._precheck_cache[mbs]
+        micro = resize_batch(self.sample_batch, mbs * jax.device_count())
+        abstract = jax.eval_shape(
+            lambda r, b: self.model.init(r, b), jax.random.key(0), micro)
+        try:
+            compiled = jax.jit(self.model.apply).lower(abstract, micro).compile()
+        except Exception as e:
+            self._precheck_cache[mbs] = (None, 0.0)
+            logger.warning(f"fwd AOT precheck failed for mbs={mbs}: {e}")
+            return self._precheck_cache[mbs]
+        mem = xla_memory_analysis(compiled)
+        flops = xla_flops_analysis(compiled)
+        if mem and mem["total_bytes"] > device_memory_limit() * jax.device_count():
+            raise MemoryError(
+                f"XLA fwd program needs {memory_to_string(mem['total_bytes'])} "
+                f"(> budget) at micro_batch={mbs}")
+        self._precheck_cache[mbs] = (mem, flops)
+        return self._precheck_cache[mbs]
+
     def _run_experiment(self, exp):
         """Measure one candidate on the real fused train step."""
         import jax
@@ -147,6 +181,8 @@ class Autotuner:
         cfg.setdefault("autotuning", {})
         if isinstance(cfg["autotuning"], dict):
             cfg["autotuning"]["enabled"] = False
+        _, fwd_flops = self._compile_precheck(
+            cfg.get("train_micro_batch_size_per_gpu", 1))
         engine, *_ = self._ds.initialize(model=self.model, config=cfg)
         try:
             mbs = engine.train_micro_batch_size_per_gpu()
@@ -167,9 +203,16 @@ class Autotuner:
             dt = time.perf_counter() - t0
             latency = dt / self.measure_steps
             throughput = engine.train_batch_size() / latency
+            # FLOPS metric: fwd+bwd ≈ 3× the XLA-counted fwd flops (falls
+            # back to the 6ND estimate when the backend hides cost analysis)
+            if not fwd_flops:
+                fwd_flops = 2.0 * self.model_info()[C.MODEL_INFO_NUM_PARAMS] \
+                    * mbs * jax.device_count()
+            flops_per_sec = 3.0 * fwd_flops * gas / latency
             return {
                 C.AUTOTUNING_METRIC_LATENCY: latency,
                 C.AUTOTUNING_METRIC_THROUGHPUT: throughput,
+                C.AUTOTUNING_METRIC_FLOPS: flops_per_sec,
                 "train_batch_size": engine.train_batch_size(),
                 "train_micro_batch_size_per_gpu": mbs,
                 "zero_stage": engine.zero_optimization_stage(),

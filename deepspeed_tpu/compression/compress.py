@@ -111,10 +111,19 @@ def init_compression(params, ds_config, teacher_params=None, mpu=None):
                                 continue
                             # pair with the match(es) sharing the deepest
                             # common ancestor — e.g. layer_0/intermediate/dense
-                            # pairs with layer_0/output/dense, not layer_1's
+                            # pairs with layer_0/output/dense, not layer_1's.
+                            # Nested modules must share at least one ancestor:
+                            # a zero-overlap candidate set would otherwise
+                            # pair m with every match in the model.
+                            floor = 1 if "/" in m else 0
                             best = max(_common_depth(m, r) for r in cands)
-                            rel += [r for r in cands
-                                    if _common_depth(m, r) == best]
+                            if best >= floor:
+                                rel += [r for r in cands
+                                        if _common_depth(m, r) == best]
+                            else:
+                                logger.warning(
+                                    f"related_modules pattern {rpat!r} has no "
+                                    f"match near {m!r}; skipping")
                 gparams = dict(g[C.DIFFERENT_GROUPS_PARAMETERS])
                 gparams.setdefault(C.TECHNIQUE_SCHEDULE_OFFSET,
                                    shared.get(C.TECHNIQUE_SCHEDULE_OFFSET, 0))

@@ -26,13 +26,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+import os as _os
+
+# tuned on v5e at seq 2048/head_dim 64: large kv blocks amortize the
+# VPU-bound online-softmax bookkeeping (see bench sweep in commit message)
+DEFAULT_BLOCK_Q = int(_os.environ.get("DSTPU_FLASH_BLOCK_Q", "256"))
+DEFAULT_BLOCK_K = int(_os.environ.get("DSTPU_FLASH_BLOCK_K", "2048"))
 NEG_INF = -1e30
-# LSE/delta row vectors are stored with a broadcast 128-lane trailing dim so
-# every Pallas block is (sublane, lane)-tileable on real TPU Mosaic (same
-# layout trick as jax's reference TPU flash kernel's l/m tensors).
-LSE_LANES = 128
+# LSE/delta row vectors carry a small broadcast trailing dim: Mosaic requires
+# the last block dim be 128-divisible OR equal to the full array dim, so an
+# 8-lane array keeps blocks legal while costing 16x less HBM than 128 lanes
+# (these are saved residuals when attention outputs are remat-saveable).
+LSE_LANES = 8
 
 
 def _interpret():
@@ -68,41 +73,67 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # skip kv blocks strictly above the causal diagonal
+    # block classification: interior blocks (fully inside the causal
+    # triangle and inside the sequence) skip all mask/iota VPU work — with
+    # online softmax that work is a large share of kernel time at small D
+    even_kv = kv_len % block_k == 0
     run = (not causal) or (ik * block_k <= iq * block_q + block_q - 1)
+    diag = causal and (ik * block_k + block_k > iq * block_q)
+    needs_mask = diag if even_kv else True
 
-    @pl.when(run)
-    def _body():
-        q = q_ref[0, 0].astype(jnp.float32)          # [bq, d]
-        k = k_ref[0, 0].astype(jnp.float32)          # [bk, d]
-        v = v_ref[0, 0].astype(jnp.float32)          # [bk, d]
-        # zero padded tail rows: OOB block reads are undefined, and
-        # garbage * 0-probability still poisons the matmul with NaN
-        kv_rows = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
-                                                          (block_k, 1), 0)
-        valid_kv = kv_rows < kv_len
-        k = jnp.where(valid_kv, k, 0.0)
-        v = jnp.where(valid_kv, v, 0.0)
+    def _softmax_update(s, v):
+        m_prev = m_scr[:, 0:1]                        # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                        # [bq, bk] f32
+        corr = jnp.exp(m_prev - m_new)                # [bq, 1]
+        l_new = l_scr[:, 0:1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(run & jnp.logical_not(needs_mask))
+    def _interior():
+        # operands stay bf16 — the MXU accumulates in fp32 via
+        # preferred_element_type; casting inputs to fp32 would halve
+        # matmul throughput
+        s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        _softmax_update(s, v_ref[0, 0])
+
+    @pl.when(run & needs_mask)
+    def _masked():
+        q = q_ref[0, 0]                              # [bq, d]
+        k = k_ref[0, 0]                              # [bk, d]
+        v = v_ref[0, 0]                              # [bk, d]
+        if not even_kv:
+            # zero padded tail rows: OOB block reads are undefined, and
+            # garbage * 0-probability still poisons the matmul with NaN
+            kv_rows = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                              (block_k, 1), 0)
+            valid_kv = kv_rows < kv_len
+            k = jnp.where(valid_kv, k, jnp.zeros_like(k))
+            v = jnp.where(valid_kv, v, jnp.zeros_like(v))
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         cols = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
                                                        (block_q, block_k), 1)
-        mask = cols < kv_len           # tail-block padding
-        if causal:
+        if even_kv:
+            # only diagonal blocks reach here — causal mask alone
             rows = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
                                                            (block_q, block_k), 0)
-            mask = mask & (rows >= cols)
+            mask = rows >= cols
+        else:
+            mask = cols < kv_len       # tail-block padding
+            if causal:
+                rows = iq * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                mask = mask & (rows >= cols)
         s = jnp.where(mask, s, NEG_INF)
-        m_prev = m_scr[:, 0:1]                        # [bq, 1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                        # [bq, bk]
-        corr = jnp.exp(m_prev - m_new)                # [bq, 1]
-        l_new = l_scr[:, 0:1] * corr + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        _softmax_update(s, v)
 
     @pl.when(ik == nk - 1)
     def _finish():
@@ -155,6 +186,8 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(q, k, v)
     return out, lse
@@ -172,37 +205,58 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
+    even_kv = kv_len % block_k == 0
     run = (not causal) or (ik * block_k <= iq * block_q + block_q - 1)
+    diag = causal and (ik * block_k + block_k > iq * block_q)
+    needs_mask = diag if even_kv else True
 
-    @pl.when(run)
-    def _body():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+    def _accum(p, do, v, k, delta):
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
+        dq_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(run & jnp.logical_not(needs_mask))
+    def _interior():
+        lse = lse_ref[0, 0][:, 0:1]                  # [bq, 1]
+        s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)                          # [bq, bk]
+        _accum(p, do_ref[0, 0], v_ref[0, 0], k_ref[0, 0],
+               delta_ref[0, 0][:, 0:1])
+
+    @pl.when(run & needs_mask)
+    def _masked():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, 0:1]                  # [bq, 1]
         delta = delta_ref[0, 0][:, 0:1]              # [bq, 1]
-        kv_rows = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
-                                                          (block_k, 1), 0)
-        valid_kv = kv_rows < kv_len
-        k = jnp.where(valid_kv, k, 0.0)
-        v = jnp.where(valid_kv, v, 0.0)
+        if not even_kv:
+            kv_rows = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                              (block_k, 1), 0)
+            valid_kv = kv_rows < kv_len
+            k = jnp.where(valid_kv, k, jnp.zeros_like(k))
+            v = jnp.where(valid_kv, v, jnp.zeros_like(v))
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         cols = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
                                                        (block_q, block_k), 1)
-        mask = cols < kv_len
-        if causal:
+        if even_kv:
             rows = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
                                                            (block_q, block_k), 0)
-            mask = mask & (rows >= cols)
-        s = jnp.where(mask, s, NEG_INF)
+            mask = rows >= cols
+        else:
+            mask = cols < kv_len
+            if causal:
+                rows = iq * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                mask = mask & (rows >= cols)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)    # [bq, bk]
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
-        dq_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                         preferred_element_type=jnp.float32)
+        _accum(p, do, v, k, delta)
 
     @pl.when(ik == nk - 1)
     def _finish():
@@ -220,42 +274,65 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
+    even_q = q_len % block_q == 0
     run = (not causal) or (iq * block_q + block_q - 1 >= ik * block_k)
+    diag = causal and (iq * block_q < ik * block_k + block_k)
+    needs_mask = diag if even_q else True
 
-    @pl.when(run)
-    def _body():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+    def _accum(p, q, v, do, delta):
+        dv_scr[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)  # [bq, bk]
+        dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(run & jnp.logical_not(needs_mask))
+    def _interior():
+        lse = lse_ref[0, 0][:, 0:1]
+        s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
+                                (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)
+        _accum(p, q_ref[0, 0], v_ref[0, 0], do_ref[0, 0],
+               delta_ref[0, 0][:, 0:1])
+
+    @pl.when(run & needs_mask)
+    def _masked():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, 0:1]
         delta = delta_ref[0, 0][:, 0:1]
-        q_rows = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
-                                                         (block_q, 1), 0)
-        valid_q = q_rows < q_len
-        q = jnp.where(valid_q, q, 0.0)
-        do = jnp.where(valid_q, do, 0.0)
-        # delta/lse of padded rows are OOB reads; 0*(garbage) must stay finite
-        delta = jnp.where(valid_q, delta, 0.0)
-        lse = jnp.where(valid_q, lse, 0.0)
+        if not even_q:
+            q_rows = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                             (block_q, 1), 0)
+            valid_q = q_rows < q_len
+            q = jnp.where(valid_q, q, jnp.zeros_like(q))
+            do = jnp.where(valid_q, do, jnp.zeros_like(do))
+            # delta/lse of padded rows are OOB reads; 0*garbage must stay
+            # finite
+            delta = jnp.where(valid_q, delta, 0.0)
+            lse = jnp.where(valid_q, lse, 0.0)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         rows = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
                                                        (block_q, block_k), 0)
-        mask = rows < q_len
-        if causal:
+        if even_q:
             cols = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
                                                            (block_q, block_k), 1)
-            mask = mask & (rows >= cols)
-        s = jnp.where(mask, s, NEG_INF)
+            mask = rows >= cols
+        else:
+            mask = rows < q_len
+            if causal:
+                cols = ik * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                mask = mask & (rows >= cols)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)    # [bq, bk]
-        dv_scr[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                         preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale                 # [bq, bk]
-        dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                         preferred_element_type=jnp.float32)
+        _accum(p, q, v, do, delta)
 
     @pl.when(iq == nq - 1)
     def _finish():
@@ -301,6 +378,8 @@ def _bwd(scale, causal, block_q, block_k, res, do):
         out_specs=pl.BlockSpec((1, 1, block_q, D), q_map),
         out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
@@ -341,6 +420,8 @@ def _bwd(scale, causal, block_q, block_k, res, do):
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
@@ -364,6 +445,14 @@ def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
 
 def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
     out, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+    # tag residuals so a remat policy can elect to SAVE them — without the
+    # tags, any rematerialized layer re-runs the whole forward kernel inside
+    # the backward pass just to regenerate lse (out: bf16 B·S·H·D; lse: 8-lane
+    # f32 — together ~20MB/layer at opt-350m/2048, far cheaper than a
+    # recompute)
+    from jax.ad_checkpoint import checkpoint_name
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (q, k, v, out, lse)
 
 

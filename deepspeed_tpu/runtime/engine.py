@@ -685,6 +685,10 @@ class DeepSpeedEngine:
             scaler = self.loss_scaler
 
             def train_step(params, opt_state, scaler_state, lr, step, rng, batches):
+                # derive this step's stream on-device: the caller passes the
+                # same base key every step (no per-step host-side split op)
+                rng = jax.random.fold_in(rng, step)
+
                 def micro(carry, mb):
                     acc, inf_acc, r = carry
                     r, sub = jax.random.split(r)
@@ -756,10 +760,9 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         lr = jnp.asarray(self.get_lr()[0], jnp.float32)
         step_no = jnp.asarray(self.global_steps + 1, jnp.int32)
-        self._rng, rng = jax.random.split(self._rng)
         (self._params, self._opt_state, self._scaler_state, loss, gnorm) = \
             self._get_fused_step()(self._params, self._opt_state, self._scaler_state,
-                                   lr, step_no, rng, batch)
+                                   lr, step_no, self._rng, batch)
         self._last_global_grad_norm = gnorm
         self._last_loss = loss
         self.global_steps += 1

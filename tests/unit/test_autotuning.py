@@ -80,8 +80,10 @@ def test_model_based_tuner_prefers_predicted_best():
 
 def test_autotuner_end_to_end(tmp_path):
     model = SimpleModel(hidden_dim=8, nlayers=1)
+    # max_train_batch_size bounds the GLOBAL batch: 32 over the 8-device
+    # mesh → per-chip micro-batch candidates up to 4
     cfg = _base_config(tmp_path, num_tuning_micro_batch_sizes=2,
-                      max_train_batch_size=4, fast=True)
+                      max_train_batch_size=32, fast=True)
     tuner = Autotuner(model, cfg, random_batch(batch_size=2, dim=8, classes=8),
                       zero_stages=[0, 1])
     best = tuner.tune()
@@ -95,9 +97,23 @@ def test_autotuner_end_to_end(tmp_path):
     assert len(results["experiments"]) >= 2
     assert os.path.exists(os.path.join(cfg["autotuning"]["results_dir"],
                                        "ds_config_optimal.json"))
-    # every experiment measured a real throughput
+    # every experiment measured a real throughput and a flops estimate
     for e in results["experiments"]:
         assert e["results"].get("throughput", 0) > 0, e
+        assert e["results"].get("flops", 0) > 0, e
+
+
+def test_autotuner_flops_metric(tmp_path):
+    """metric='flops' must select a config (reference supports the FLOPS
+    metric; results must carry the key the tuner ranks by)."""
+    model = SimpleModel(hidden_dim=8, nlayers=1)
+    cfg = _base_config(tmp_path, metric="flops", num_tuning_micro_batch_sizes=2,
+                      max_train_batch_size=32)
+    tuner = Autotuner(model, cfg, random_batch(batch_size=2, dim=8, classes=8),
+                      zero_stages=[0])
+    best = tuner.tune()
+    assert best is not None
+    assert tuner.best_metric_val > 0
 
 
 def test_autotuner_memory_prune(tmp_path, monkeypatch):

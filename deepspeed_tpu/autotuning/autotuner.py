@@ -152,25 +152,26 @@ class Autotuner:
         lower bound on train-step memory, so exceeding the budget here is a
         sound prune; returns the fwd flop count for the FLOPS metric."""
         import jax
-        if mbs in self._precheck_cache:
-            return self._precheck_cache[mbs]
-        micro = resize_batch(self.sample_batch, mbs * jax.device_count())
-        abstract = jax.eval_shape(
-            lambda r, b: self.model.init(r, b), jax.random.key(0), micro)
-        try:
-            compiled = jax.jit(self.model.apply).lower(abstract, micro).compile()
-        except Exception as e:
-            self._precheck_cache[mbs] = (None, 0.0)
-            logger.warning(f"fwd AOT precheck failed for mbs={mbs}: {e}")
-            return self._precheck_cache[mbs]
-        mem = xla_memory_analysis(compiled)
-        flops = xla_flops_analysis(compiled)
+        if mbs not in self._precheck_cache:
+            micro = resize_batch(self.sample_batch, mbs * jax.device_count())
+            abstract = jax.eval_shape(
+                lambda r, b: self.model.init(r, b), jax.random.key(0), micro)
+            try:
+                compiled = jax.jit(self.model.apply).lower(abstract,
+                                                           micro).compile()
+                self._precheck_cache[mbs] = (xla_memory_analysis(compiled),
+                                             xla_flops_analysis(compiled))
+            except Exception as e:
+                logger.warning(f"fwd AOT precheck failed for mbs={mbs}: {e}")
+                self._precheck_cache[mbs] = (None, 0.0)
+        # budget check runs on cache hits too: every zero stage at an
+        # over-budget micro-batch must fail fast without recompiling
+        mem, flops = self._precheck_cache[mbs]
         if mem and mem["total_bytes"] > device_memory_limit() * jax.device_count():
             raise MemoryError(
                 f"XLA fwd program needs {memory_to_string(mem['total_bytes'])} "
                 f"(> budget) at micro_batch={mbs}")
-        self._precheck_cache[mbs] = (mem, flops)
-        return self._precheck_cache[mbs]
+        return mem, flops
 
     def _run_experiment(self, exp):
         """Measure one candidate on the real fused train step."""
@@ -203,16 +204,23 @@ class Autotuner:
             dt = time.perf_counter() - t0
             latency = dt / self.measure_steps
             throughput = engine.train_batch_size() / latency
-            # FLOPS metric: fwd+bwd ≈ 3× the XLA-counted fwd flops (falls
-            # back to the 6ND estimate when the backend hides cost analysis)
+            # FLOPS metric: fwd+bwd ≈ 3× the XLA-counted fwd flops; falls
+            # back to 2·N·tokens when the backend hides cost analysis
+            # (tokens per sample read off the sample batch's trailing dims)
+            flops_source = "xla"
             if not fwd_flops:
+                flops_source = "analytic"
+                tokens_per_sample = max(
+                    (int(np.prod(np.shape(l)[1:])) or 1
+                     for l in jax.tree.leaves(self.sample_batch)), default=1)
                 fwd_flops = 2.0 * self.model_info()[C.MODEL_INFO_NUM_PARAMS] \
-                    * mbs * jax.device_count()
+                    * tokens_per_sample * mbs * jax.device_count()
             flops_per_sec = 3.0 * fwd_flops * gas / latency
             return {
                 C.AUTOTUNING_METRIC_LATENCY: latency,
                 C.AUTOTUNING_METRIC_THROUGHPUT: throughput,
                 C.AUTOTUNING_METRIC_FLOPS: flops_per_sec,
+                "flops_source": flops_source,
                 "train_batch_size": engine.train_batch_size(),
                 "train_micro_batch_size_per_gpu": mbs,
                 "zero_stage": engine.zero_optimization_stage(),

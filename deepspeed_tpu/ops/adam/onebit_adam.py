@@ -77,11 +77,67 @@ class OnebitAdam:
 
 
 class ZeroOneAdam(OnebitAdam):
-    """0/1 Adam (reference ``onebit/zoadam.py:13``): adds learning-rate-freeze
-    intervals on top of variance freezing; interval policy folded into the
-    same compressed update."""
+    """0/1 Adam (reference ``onebit/zoadam.py:13``).
+
+    Unlike 1-bit Adam's hard warmup/freeze split, 0/1 Adam compresses from
+    step one and *adaptively thins* state refreshes:
+
+    * the variance is refreshed only at geometrically spaced refresh steps:
+      ``var_update_scaler`` refreshes at interval 1, then ``var_update_scaler``
+      at interval 2, then 4, ... (interval doubling per *refresh segment*,
+      capped at 2^``local_step_clipper``), until ``var_freeze_step`` freezes
+      it for good — the reference's variance-update policy;
+    * between refreshes the update reuses the stale variance — the "0" steps;
+      refresh steps are the "1" steps.  ``local_step_scaler`` is accepted for
+      config parity (the reference's lr-freeze/local-step machinery is a
+      communication-skipping device that GSPMD makes moot).
+    """
 
     def __init__(self, var_freeze_step=100000, var_update_scaler=16,
                  local_step_scaler=32678, local_step_clipper=16, **kw):
         kw.pop("freeze_step", None)
         super().__init__(freeze_step=var_freeze_step, **kw)
+        self.var_update_scaler = var_update_scaler
+        self.local_step_scaler = local_step_scaler
+        self.local_step_clipper = local_step_clipper
+
+    def _is_refresh_step(self, step):
+        """True at geometrically spaced refresh steps.  Segment j holds
+        ``R = var_update_scaler`` refreshes at interval 2^j and starts after
+        step ``S_j = R·(2^j − 1)``; a step refreshes iff its offset into its
+        segment is a multiple of the segment interval."""
+        R = float(self.var_update_scaler)
+        j = jnp.floor(jnp.log2(jnp.maximum(step / R + 1.0, 1.0)))
+        j = jnp.minimum(j, float(self.local_step_clipper))
+        interval = 2.0 ** j
+        seg_start = R * (interval - 1.0)
+        return jnp.mod(step - seg_start, interval) < 0.5
+
+    def update(self, grads, state, params, lr=None, step=1):
+        lr = self.lr if lr is None else lr
+        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
+        step = jnp.asarray(step, dtype=jnp.float32)
+        refresh = self._is_refresh_step(step) & (step <= self.freeze_step)
+        bc1 = 1.0 - b1 ** step
+        bc2 = 1.0 - b2 ** jnp.minimum(step, float(self.freeze_step))
+
+        def leaf(p, g, m, v, e):
+            g32 = g.astype(self.master_dtype)
+            p32 = p.astype(self.master_dtype)
+            m_new = b1 * m + (1.0 - b1) * g32
+            # compression is always on in 0/1 Adam
+            corrected = m_new + e
+            scale = jnp.mean(jnp.abs(corrected))
+            compressed = jnp.sign(corrected) * scale
+            e_new = corrected - compressed
+            v_new = jnp.where(refresh, b2 * v + (1.0 - b2) * (g32 * g32), v)
+            upd = (compressed / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if wd != 0.0:
+                upd = upd + wd * p32
+            return (p32 - lr * upd).astype(p.dtype), compressed, v_new, e_new
+
+        out = jax.tree.map(leaf, params, grads, state.exp_avg, state.exp_avg_sq,
+                           state.error_feedback)
+        is_t = lambda t: isinstance(t, tuple)
+        pick = lambda i: jax.tree.map(lambda t: t[i], out, is_leaf=is_t)
+        return pick(0), OnebitAdamState(pick(1), pick(2), pick(3))

@@ -1,0 +1,103 @@
+"""1-bit LAMB — TPU-native re-design of reference
+``runtime/fp16/onebit/lamb.py:14`` (OnebitLamb).
+
+Algorithm (Li et al., "1-bit LAMB"): exact LAMB during ``freeze_step`` warmup;
+afterwards the variance term and the per-tensor LAMB trust ratios are frozen
+(the reference caches ``lamb_coeffs`` at the freeze boundary) and the momentum
+is communicated compressed — modeled here as sign × mean-magnitude with an
+error-feedback buffer, the same update rule the reference applies after its
+compressed allreduce (``runtime/comm/nccl.py:54``).  Post-freeze, the frozen
+trust ratio is scaled by the ratio of current to frozen momentum scale
+(reference's ``scaling_coeff`` update).
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class OnebitLambState(NamedTuple):
+    exp_avg: Any
+    exp_avg_sq: Any
+    error_feedback: Any
+    frozen_lamb_coeff: Any   # per-tensor trust ratio cached at freeze
+    frozen_m_scale: Any      # per-tensor mean|m| cached at freeze
+
+
+class OnebitLamb:
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                 max_coeff=10.0, min_coeff=0.01, freeze_step=100000,
+                 cuda_aware=False, comm_backend_name="xla",
+                 coeff_beta=0.9, factor_max=4.0, factor_min=0.5,
+                 factor_threshold=0.1, master_dtype=jnp.float32):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.max_coeff = max_coeff
+        self.min_coeff = min_coeff
+        self.freeze_step = freeze_step
+        self.factor_max = factor_max
+        self.factor_min = factor_min
+        self.master_dtype = master_dtype
+
+    def init(self, params):
+        zeros = lambda p: jnp.zeros(p.shape, dtype=self.master_dtype)
+        scalar = lambda p: jnp.asarray(1.0, dtype=self.master_dtype)
+        return OnebitLambState(
+            exp_avg=jax.tree.map(zeros, params),
+            exp_avg_sq=jax.tree.map(zeros, params),
+            error_feedback=jax.tree.map(zeros, params),
+            frozen_lamb_coeff=jax.tree.map(scalar, params),
+            frozen_m_scale=jax.tree.map(scalar, params))
+
+    def update(self, grads, state, params, lr=None, step=1):
+        lr = self.lr if lr is None else lr
+        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
+        step = jnp.asarray(step, dtype=jnp.float32)
+        warmup = step <= self.freeze_step
+        at_freeze = step == self.freeze_step
+        bc1 = 1.0 - b1 ** step
+        bc2 = 1.0 - b2 ** jnp.minimum(step, float(self.freeze_step))
+
+        def leaf(p, g, m, v, e, coeff, mscale):
+            g32 = g.astype(self.master_dtype)
+            p32 = p.astype(self.master_dtype)
+            m_new = b1 * m + (1.0 - b1) * g32
+            # post-freeze: compressed momentum (sign × scale, error feedback)
+            corrected = m_new + e
+            scale = jnp.mean(jnp.abs(corrected))
+            compressed = jnp.sign(corrected) * scale
+            e_new = jnp.where(warmup, e, corrected - compressed)
+            m_eff = jnp.where(warmup, m_new, compressed)
+            v_new = jnp.where(warmup, b2 * v + (1.0 - b2) * (g32 * g32), v)
+            upd = (m_eff / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if wd != 0.0:
+                upd = upd + wd * p32
+            # LAMB trust ratio: exact during warmup; frozen (and rescaled by
+            # the momentum-scale drift, clipped to factor bounds) afterwards
+            w_norm = jnp.linalg.norm(p32)
+            u_norm = jnp.linalg.norm(upd)
+            live = jnp.where((w_norm > 0) & (u_norm > 0),
+                             jnp.clip(w_norm / jnp.maximum(u_norm, 1e-12),
+                                      self.min_coeff, self.max_coeff),
+                             1.0)
+            coeff_new = jnp.where(warmup, live, coeff)
+            coeff_new = jnp.where(at_freeze, live, coeff_new)
+            mscale_new = jnp.where(warmup | at_freeze,
+                                   jnp.maximum(scale, 1e-12), mscale)
+            drift = jnp.clip(scale / jnp.maximum(mscale, 1e-12),
+                             self.factor_min, self.factor_max)
+            eff_coeff = jnp.where(warmup, live, coeff_new * drift)
+            return ((p32 - lr * eff_coeff * upd).astype(p.dtype),
+                    m_eff, v_new, e_new, coeff_new, mscale_new)
+
+        out = jax.tree.map(leaf, params, grads, state.exp_avg,
+                           state.exp_avg_sq, state.error_feedback,
+                           state.frozen_lamb_coeff, state.frozen_m_scale)
+        is_t = lambda t: isinstance(t, tuple)
+        pick = lambda i: jax.tree.map(lambda t: t[i], out, is_leaf=is_t)
+        return pick(0), OnebitLambState(pick(1), pick(2), pick(3), pick(4),
+                                        pick(5))

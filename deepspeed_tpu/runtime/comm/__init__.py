@@ -1,0 +1,7 @@
+from deepspeed_tpu.runtime.comm.compressed import (  # noqa: F401
+    CompressedBackend, compressed_allreduce, pack_signs, unpack_signs)
+
+# reference parity aliases (runtime/comm/nccl.py NcclBackend,
+# runtime/comm/mpi.py MpiBackend): one backend serves both roles on TPU
+NcclBackend = CompressedBackend
+MpiBackend = CompressedBackend

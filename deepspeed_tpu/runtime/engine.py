@@ -927,10 +927,12 @@ def _opt_state_shardings(abstract_opt, abstract_params, opt_specs, mesh):
     params_def = jax.tree.structure(abstract_params)
 
     def field_shardings(field):
+        from deepspeed_tpu.runtime.zero.partition import spec_or_replicated
         try:
             if jax.tree.structure(field) == params_def:
-                return jax.tree.map(lambda s: NamedSharding(mesh, s), opt_specs,
-                                    is_leaf=lambda x: isinstance(x, P))
+                return jax.tree.map(
+                    lambda s, leaf: spec_or_replicated(mesh, s, leaf),
+                    opt_specs, field, is_leaf=lambda x: isinstance(x, P))
         except Exception:
             pass
         return jax.tree.map(lambda _: NamedSharding(mesh, P()), field)

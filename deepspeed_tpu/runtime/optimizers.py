@@ -137,13 +137,17 @@ def build_optimizer(opt_config):
         return FusedAdam(adam_w_mode=adam_w, **params)
     if name == C.ADAMW_OPTIMIZER:
         return FusedAdamW(**params)
-    if name in (C.LAMB_OPTIMIZER, C.ONEBIT_LAMB_OPTIMIZER):
-        params.pop("freeze_step", None)
-        params.pop("comm_backend_name", None)
+    if name == C.LAMB_OPTIMIZER:
         return FusedLamb(**params)
-    if name in (C.ONEBIT_ADAM_OPTIMIZER, C.ZERO_ONE_ADAM_OPTIMIZER):
+    if name == C.ONEBIT_LAMB_OPTIMIZER:
+        from deepspeed_tpu.ops.lamb.onebit_lamb import OnebitLamb
+        return OnebitLamb(**params)
+    if name == C.ONEBIT_ADAM_OPTIMIZER:
         from deepspeed_tpu.ops.adam.onebit_adam import OnebitAdam
         return OnebitAdam(**params)
+    if name == C.ZERO_ONE_ADAM_OPTIMIZER:
+        from deepspeed_tpu.ops.adam.onebit_adam import ZeroOneAdam
+        return ZeroOneAdam(**params)
     if name == C.SGD_OPTIMIZER:
         return SGD(**params)
     if name == C.ADAGRAD_OPTIMIZER:

@@ -1,0 +1,62 @@
+"""Sparse gradients — reference ``runtime/sparse_tensor.py`` (``SparseTensor``)
+and the engine's ``sparse_allreduce_no_retain`` path (``engine.py:2312``) for
+sparse embedding gradients.
+
+COO representation: ``indices`` [nnz] row ids + ``values`` [nnz, row_dim].
+The reduction allgathers (indices, values) over the dp axis — exactly what
+the reference's sparse allreduce does with all_gather of irregular tensors —
+then either keeps the concatenated COO or densifies via ``segment_sum``
+(duplicate rows add, matching embedding-grad semantics).  XLA needs static
+nnz, so each rank's nnz is padded to the max (padding rows point at row 0
+with zero values).
+"""
+
+from typing import Any, NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class SparseTensor(NamedTuple):
+    indices: Any          # [nnz] int32 row indices
+    values: Any           # [nnz, row_dim]
+    dense_size: Any       # (num_rows, row_dim)
+
+    @staticmethod
+    def from_dense(dense, threshold=0.0):
+        """Rows with any |value| > threshold become COO entries (embedding
+        grads: most rows are exactly zero)."""
+        d = np.asarray(dense)
+        nz = np.where(np.abs(d).max(axis=tuple(range(1, d.ndim))) > threshold)[0]
+        return SparseTensor(indices=jnp.asarray(nz, jnp.int32),
+                            values=jnp.asarray(d[nz]),
+                            dense_size=d.shape)
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_size, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def sparse_size(self):
+        nnz = int(np.prod(self.values.shape))
+        return nnz, int(np.prod(self.dense_size))
+
+
+def sparse_allreduce(sparse, axis, mesh=None):
+    """Mean-allreduce a per-device SparseTensor over mesh axis ``axis``;
+    callable inside shard_map (reference ``engine.py:2340 sparse_allreduce``).
+    Returns a SparseTensor whose COO lists are the concatenation over the
+    axis (values pre-divided by world size)."""
+    from jax import lax
+    W = lax.psum(1, axis)
+    idx = lax.all_gather(sparse.indices, axis, tiled=True)
+    vals = lax.all_gather(sparse.values, axis, tiled=True) / W
+    return SparseTensor(idx, vals, sparse.dense_size)
+
+
+def sparse_allreduce_to_dense(dense_grad, axis):
+    """Densifying fallback (reference ``sparse_allreduce_no_retain`` with
+    dense output): psum is already optimal when rows are mostly nonzero."""
+    from jax import lax
+    return lax.pmean(dense_grad, axis)

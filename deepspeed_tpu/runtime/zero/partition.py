@@ -147,6 +147,17 @@ def tp_spec_for(path_str, shape, mesh, rules=None):
 
 
 # --------------------------------------------------------------------- #
+def spec_or_replicated(mesh, spec, leaf):
+    """NamedSharding for ``leaf`` under ``spec`` — replicated when the spec
+    has more dims than the leaf.  Optimizer states may carry per-tensor
+    scalar stats (e.g. 1-bit LAMB's frozen trust ratios) that mirror a
+    param's tree *path* but not its rank; a param-ranked spec would be an
+    invalid sharding for them."""
+    if len(spec) > getattr(leaf, "ndim", np.ndim(leaf)):
+        return NamedSharding(mesh, P())
+    return NamedSharding(mesh, spec)
+
+
 class ZeroShardingPlan:
     """Per-tree PartitionSpec plans for the three state classes."""
 
@@ -178,7 +189,7 @@ class ZeroShardingPlan:
             ps = path_to_str(path)
             for k, s in flat_specs.items():
                 if ps.endswith(k) or k.endswith(ps):
-                    return NamedSharding(self.mesh, s)
+                    return spec_or_replicated(self.mesh, s, leaf)
             # scalars (loss scale, step counters) replicate
             if np.ndim(leaf) == 0 or not hasattr(leaf, "shape") or leaf.shape == ():
                 return NamedSharding(self.mesh, P())

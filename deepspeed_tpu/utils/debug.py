@@ -1,0 +1,61 @@
+"""Debug helpers (reference ``deepspeed/utils/debug.py``): name maps for
+modules/params and rank-guarded printing for multi-host runs."""
+
+import os
+
+import numpy as np
+
+import jax
+
+module_names = {}
+param_names = {}
+
+
+def debug_extract_module_and_param_names(params, prefix=""):
+    """Flatten a param pytree into {path: shape} maps (the analog of the
+    reference's named_modules/named_parameters walk)."""
+    global param_names
+    out = {}
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, f"{path}/{k}" if path else k)
+        elif hasattr(node, "shape"):
+            out[path] = tuple(node.shape)
+
+    walk(params, prefix)
+    param_names = out
+    return out
+
+
+def debug_param2name_id_shape(path, value):
+    return f"name={path} id={id(value)} shape={tuple(np.shape(value))}"
+
+
+def print_rank_0(message, debug=True, force=False):
+    if (debug or force) and jax.process_index() == 0:
+        print(message, flush=True)
+
+
+def debug_rank0(message, debug=True):
+    print_rank_0(message, debug)
+
+
+def printflock(*msgs):
+    """Interleave-safe print across processes (reference printflock uses an
+    fcntl lock; multi-host TPU processes share no fs lock, so prefix with the
+    process index instead)."""
+    print(f"[proc {jax.process_index()}]", *msgs, flush=True)
+
+
+def log_rank_file(rank, *msgs):
+    """Per-rank debug log files (reference ``log_rank_file``)."""
+    path = f"debug_rank_{rank}.txt"
+    with open(path, "a") as f:
+        for m in msgs:
+            f.write(f"{m}\n")
+
+
+def enabled():
+    return os.environ.get("DSTPU_DEBUG", "0") == "1"

@@ -1,0 +1,72 @@
+"""Process-group registry — reference ``deepspeed/utils/groups.py`` (expert /
+expert-data / model parallel group creation and cached getters).
+
+On TPU a "group" is a set of mesh axes, not an NCCL communicator; creation is
+free and the getters answer from the live ``ParallelTopology``.  Reference
+names are preserved so engine/MoE code ports directly.
+"""
+
+from deepspeed_tpu.parallel import topology as _topo
+
+
+def _require_topo():
+    t = _topo.get_topology()
+    if t is None:
+        raise RuntimeError("topology not initialized; call "
+                           "deepspeed_tpu.initialize or initialize_topology")
+    return t
+
+
+def _create_expert_and_data_parallel(expert_parallel_size):
+    """Reference ``groups.py:108``: on TPU this is a mesh re-build."""
+    return _topo.initialize_topology(ep=expert_parallel_size)
+
+
+def _create_expert_data_and_model_parallel(expert_parallel_size, mpu=None,
+                                           tensor_parallel_size=1):
+    """Reference ``groups.py:202``."""
+    return _topo.initialize_topology(ep=expert_parallel_size,
+                                     tp=tensor_parallel_size)
+
+
+# cached getters (reference groups.py:280-392) — groups are axis tuples
+def _get_data_parallel_group():
+    return _require_topo().get_data_parallel_axes()
+
+
+def _get_model_parallel_group():
+    return _require_topo().get_model_parallel_axes()
+
+
+def _get_expert_parallel_group(name=None):
+    return _require_topo().get_expert_parallel_axes()
+
+
+def _get_expert_data_parallel_group(name=None):
+    return _require_topo().get_expert_data_parallel_axes()
+
+
+def _get_sequence_parallel_group():
+    return _require_topo().get_sequence_parallel_axes()
+
+
+def _get_data_parallel_world_size():
+    return _require_topo().get_data_parallel_world_size()
+
+
+def _get_model_parallel_world_size():
+    return _require_topo().get_model_parallel_world_size()
+
+
+def _get_expert_parallel_world_size(name=None):
+    return _require_topo().get_expert_parallel_world_size()
+
+
+def _get_data_parallel_rank():
+    import jax
+    return jax.process_index()
+
+
+def _get_expert_model_parallel_world_size():
+    t = _require_topo()
+    return t.get_expert_parallel_world_size() * t.get_model_parallel_world_size()

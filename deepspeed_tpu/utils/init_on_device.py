@@ -1,0 +1,64 @@
+"""``OnDevice`` — materialization-free model init, reference
+``deepspeed/utils/init_on_device.py`` (``OnDevice`` meta-tensor context).
+
+The reference monkey-patches tensor constructors to build torch modules on
+the ``meta`` device.  JAX has this natively: ``jax.eval_shape`` traces init
+without allocating.  The context keeps the reference's API shape and adds
+the TPU-idiomatic ``abstract_init`` helper.
+"""
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+
+class OnDevice(contextlib.AbstractContextManager):
+    """``with OnDevice(dtype=jnp.bfloat16, device="meta"): ...``
+
+    Inside the context, ``abstract_init(model, *args)`` returns the abstract
+    (shape/dtype-only) parameter pytree; with ``device`` set to a real jax
+    device, init is jitted and placed there directly.
+    """
+
+    _current = None
+
+    def __init__(self, dtype=None, device="meta", enabled=True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = OnDevice._current
+        if self.enabled:
+            OnDevice._current = self
+        return self
+
+    def __exit__(self, *exc):
+        OnDevice._current = self._prev
+        return False
+
+    def _cast(self, tree):
+        if self.dtype is None:
+            return tree
+        return jax.tree.map(
+            lambda l: (l if not jnp.issubdtype(l.dtype, jnp.floating) else
+                       (jax.ShapeDtypeStruct(l.shape, self.dtype)
+                        if isinstance(l, jax.ShapeDtypeStruct)
+                        else l.astype(self.dtype))), tree)
+
+    def abstract_init(self, init_fn, *args, **kwargs):
+        if self.device == "meta":
+            return self._cast(jax.eval_shape(init_fn, *args, **kwargs))
+        out = jax.jit(init_fn)(*args, **kwargs)
+        out = self._cast(out)
+        if self.device is not None:
+            out = jax.device_put(out, self.device)
+        return out
+
+
+def abstract_init(init_fn, *args, **kwargs):
+    """Module-level convenience honoring an active ``OnDevice`` context."""
+    ctx = OnDevice._current or OnDevice()
+    return ctx.abstract_init(init_fn, *args, **kwargs)

@@ -1,0 +1,75 @@
+"""Access to full / fragmented optimizer-state views — TPU-native re-design of
+reference ``deepspeed/utils/tensor_fragment.py`` (``safe_get_full_fp32_param``
+etc., used for debugging and universal checkpointing).
+
+The reference maintains explicit fragment maps because ZeRO flattens and
+slices tensors by hand.  Under GSPMD the "fragments" are just the shards of a
+sharded ``jax.Array``, so the full view is ``jax.device_get`` (an all-gather)
+and a fragment is ``array.addressable_shards`` — these helpers keep the
+reference's API names so user diagnostics port 1:1.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _lookup(tree, path):
+    node = tree
+    for part in path.split("/"):
+        if part:
+            node = node[part]
+    return node
+
+
+def safe_get_full_fp32_param(engine, param_path):
+    """Full fp32 master weight of one parameter (reference
+    ``safe_get_full_fp32_param``).  ``param_path``: '/'-joined tree path."""
+    if engine.params is None:
+        return None
+    return np.asarray(jax.device_get(_lookup(engine.params, param_path)))
+
+
+def safe_set_full_fp32_param(engine, param_path, value):
+    """Overwrite one master weight, preserving its sharding (reference
+    ``safe_set_full_fp32_param``)."""
+    cur = _lookup(engine._params, param_path)
+    new = jax.device_put(jnp.asarray(value, cur.dtype), cur.sharding)
+
+    def replace(tree, parts):
+        key = parts[0]
+        if len(parts) == 1:
+            return {**tree, key: new}
+        return {**tree, key: replace(tree[key], parts[1:])}
+
+    engine._params = replace(engine._params, [p for p in param_path.split("/") if p])
+
+
+def safe_get_full_optimizer_state(engine, param_path, optim_state_key):
+    """Full view of one optimizer-state slot, e.g. 'exp_avg' (reference
+    ``safe_get_full_optimizer_state``)."""
+    if engine._opt_state is None:
+        return None
+    field = getattr(engine._opt_state, optim_state_key, None)
+    if field is None and hasattr(engine._opt_state, "_asdict"):
+        field = engine._opt_state._asdict().get(optim_state_key)
+    if field is None:
+        return None
+    return np.asarray(jax.device_get(_lookup(field, param_path)))
+
+
+def safe_get_full_grad(engine, param_path):
+    """Most recent full gradient for a param (reference
+    ``safe_get_full_grad``); engine retains grads only between backward and
+    step in the 3-call API."""
+    grads = getattr(engine, "_staged_grads", None)
+    if grads is None:
+        return None
+    return np.asarray(jax.device_get(_lookup(grads, param_path)))
+
+
+def get_local_fragment(array):
+    """This process's shards of a sharded array — the analog of the
+    reference's mapped flat fragment."""
+    return [(s.index, np.asarray(s.data)) for s in array.addressable_shards]

@@ -1,0 +1,140 @@
+"""Multi-node runners — reference ``launcher/multinode_runner.py``
+(``MultiNodeRunner`` ABC ``:18`` + PDSH ``:51`` / OpenMPI ``:107`` / MPICH
+``:160`` / SLURM ``:217`` / MVAPICH ``:265``).
+
+Each runner turns (hostfile resources, user script, env) into the one shell
+command that fans the per-host process out.  On TPU pods the per-host
+process is a single JAX controller; the env exported to every host carries
+the ``jax.distributed`` coordinator triple (the analog of the reference's
+MASTER_ADDR/RANK env) — DSTPU_COORDINATOR_ADDRESS / DSTPU_NUM_PROCESSES /
+DSTPU_PROCESS_ID (the last is assigned per-host by the runner's rank
+mechanism: pdsh %n, SLURM_PROCID, OMPI rank, …).
+"""
+
+import os
+import shutil
+import sys
+from abc import ABC, abstractmethod
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class MultiNodeRunner(ABC):
+
+    def __init__(self, args, world_info_base64=""):
+        self.args = args
+        self.user_script = getattr(args, "user_script", "")
+        self.user_arguments = list(getattr(args, "user_args", []))
+        self.world_info_base64 = world_info_base64
+        self.exports = {}
+
+    def add_export(self, key, var):
+        self.exports[key.strip()] = str(var).strip()
+
+    @property
+    def name(self):
+        return type(self).__name__
+
+    @abstractmethod
+    def backend_exists(self):
+        ...
+
+    @abstractmethod
+    def get_cmd(self, environment, active_resources):
+        """Build the launch argv (reference ``get_cmd``)."""
+
+    def validate_args(self):
+        if not self.user_script:
+            raise ValueError(f"{self.name}: no user script to launch")
+
+
+class PDSHRunner(MultiNodeRunner):
+    """Reference ``:51``: pdsh -w host1,host2 '<env> python script args'."""
+
+    def backend_exists(self):
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        self.validate_args()
+        hosts = ",".join(active_resources.keys())
+        env_flags = [f"export {k}={v};" for k, v in self.exports.items()]
+        # %n is pdsh's per-host rank — becomes the jax process id
+        env_flags.append("export DSTPU_PROCESS_ID=%n;")
+        remote = " ".join(env_flags + [sys.executable, "-u", self.user_script]
+                          + self.user_arguments)
+        return ["pdsh", "-S", "-f", "1024", "-w", hosts, remote]
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    """Reference ``:107``: mpirun with one proc per host and -x env exports."""
+
+    def backend_exists(self):
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        self.validate_args()
+        total = len(active_resources)
+        cmd = ["mpirun", "-n", str(total), "--map-by", "ppr:1:node",
+               "-hostfile", getattr(self.args, "hostfile", "hostfile"),
+               "--mca", "btl", "^openib"]
+        for k, v in self.exports.items():
+            cmd += ["-x", f"{k}={v}"]
+        # OMPI_COMM_WORLD_RANK is read by the bootstrap as the process id
+        return cmd + [sys.executable, "-u", self.user_script] + self.user_arguments
+
+
+class MPICHRunner(MultiNodeRunner):
+    """Reference ``:160``."""
+
+    def backend_exists(self):
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        self.validate_args()
+        total = len(active_resources)
+        cmd = ["mpirun", "-n", str(total), "-ppn", "1"]
+        for k, v in self.exports.items():
+            cmd += ["-genv", k, v]
+        return cmd + [sys.executable, "-u", self.user_script] + self.user_arguments
+
+
+class SlurmRunner(MultiNodeRunner):
+    """Reference ``:217``: srun with --export and -N nodes."""
+
+    def backend_exists(self):
+        return shutil.which("srun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        self.validate_args()
+        total = len(active_resources)
+        exports = ",".join(f"{k}={v}" for k, v in self.exports.items())
+        cmd = ["srun", "-N", str(total), "--ntasks-per-node=1"]
+        if exports:
+            cmd.append(f"--export=ALL,{exports}")
+        if getattr(self.args, "comment", ""):
+            cmd += ["--comment", self.args.comment]
+        # SLURM_PROCID becomes the jax process id
+        return cmd + [sys.executable, "-u", self.user_script] + self.user_arguments
+
+
+class MVAPICHRunner(MPICHRunner):
+    """Reference ``:265`` — MVAPICH shares MPICH's cli surface for our needs."""
+
+
+RUNNERS = {
+    "pdsh": PDSHRunner,
+    "openmpi": OpenMPIRunner,
+    "mpich": MPICHRunner,
+    "slurm": SlurmRunner,
+    "mvapich": MVAPICHRunner,
+}
+
+
+def build_runner(launcher, args, world_info_base64=""):
+    if launcher not in RUNNERS:
+        raise ValueError(f"unknown launcher {launcher!r}; "
+                         f"choices: {sorted(RUNNERS)}")
+    runner = RUNNERS[launcher](args, world_info_base64)
+    if not runner.backend_exists():
+        logger.warning(f"{runner.name}: backend binary not found on PATH")
+    return runner

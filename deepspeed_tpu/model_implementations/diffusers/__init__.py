@@ -1,0 +1,2 @@
+from deepspeed_tpu.model_implementations.diffusers.vae import DSVAE  # noqa: F401
+from deepspeed_tpu.model_implementations.diffusers.unet import DSUNet  # noqa: F401

@@ -1,0 +1,28 @@
+"""DSUNet — accelerated UNet wrapper for diffusion pipelines.
+
+Reference parity: ``model_implementations/diffusers/unet.py`` (``DSUNet``):
+wraps the pipeline UNet in a captured CUDA graph replayed every denoise step.
+TPU version: the denoise step compiles once per shape and replays (the UNet
+is called hundreds of times per image with identical shapes — exactly the
+workload graph capture exists for)."""
+
+from deepspeed_tpu.model_implementations.features.cuda_graph import (
+    CompiledGraphModule)
+
+
+class DSUNet:
+
+    def __init__(self, unet, params=None, enable_cuda_graph=True):
+        self.unet = unet
+        self.params = params
+        self.config = getattr(unet, "config", None)
+        self.in_channels = getattr(unet, "in_channels", None)
+        apply = (lambda p, sample, t, enc: unet.apply(p, sample, t, enc)) \
+            if hasattr(unet, "apply") else (lambda p, sample, t, enc:
+                                            unet(sample, t, enc))
+        self._forward = CompiledGraphModule(apply, enable_cuda_graph)
+
+    def __call__(self, sample, timestep, encoder_hidden_states, params=None,
+                 **kwargs):
+        return self._forward(params if params is not None else self.params,
+                             sample, timestep, encoder_hidden_states)

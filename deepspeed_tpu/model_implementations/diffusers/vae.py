@@ -1,0 +1,46 @@
+"""DSVAE — accelerated VAE wrapper for diffusion pipelines.
+
+Reference parity: ``model_implementations/diffusers/vae.py`` (``DSVAE``):
+wraps the pipeline's VAE, routing encode/decode through captured CUDA graphs
+and the fused spatial kernels (``csrc/spatial``).  TPU version: encode /
+decode / forward each become one jitted executable (shape-keyed replay via
+CompiledGraphModule); the spatial bias-add fusion is XLA's job and the
+``ops.spatial`` helpers are used by converted modules.
+"""
+
+from deepspeed_tpu.model_implementations.features.cuda_graph import (
+    CompiledGraphModule)
+
+
+class DSVAE:
+    """``DSVAE(module, params)`` where ``module`` is a flax VAE exposing
+    ``apply(params, x, method=...)`` with ``encode``/``decode`` methods (or
+    plain callables passed via ``encode_fn``/``decode_fn``)."""
+
+    def __init__(self, vae, params=None, enable_cuda_graph=True,
+                 encode_fn=None, decode_fn=None):
+        self.vae = vae
+        self.params = params
+        self.config = getattr(vae, "config", None)
+        if encode_fn is None and hasattr(vae, "encode"):
+            encode_fn = lambda p, x: vae.apply(p, x, method=type(vae).encode)
+        if decode_fn is None and hasattr(vae, "decode"):
+            decode_fn = lambda p, x: vae.apply(p, x, method=type(vae).decode)
+        fwd_fn = (lambda p, x: vae.apply(p, x)) if hasattr(vae, "apply") \
+            else (lambda p, x: vae(x))
+        self._encode = CompiledGraphModule(encode_fn, enable_cuda_graph) \
+            if encode_fn else None
+        self._decode = CompiledGraphModule(decode_fn, enable_cuda_graph) \
+            if decode_fn else None
+        self._forward = CompiledGraphModule(fwd_fn, enable_cuda_graph)
+
+    def encode(self, x, params=None):
+        assert self._encode is not None, "wrapped VAE has no encode method"
+        return self._encode(params if params is not None else self.params, x)
+
+    def decode(self, z, params=None):
+        assert self._decode is not None, "wrapped VAE has no decode method"
+        return self._decode(params if params is not None else self.params, z)
+
+    def __call__(self, x, params=None):
+        return self._forward(params if params is not None else self.params, x)

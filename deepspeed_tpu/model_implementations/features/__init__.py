@@ -1,0 +1,2 @@
+from deepspeed_tpu.model_implementations.features.cuda_graph import (  # noqa: F401
+    CompiledGraphModule)

@@ -1,0 +1,35 @@
+"""CompiledGraphModule — the CUDA-graph feature mixin, TPU-native.
+
+Reference parity: ``model_implementations/features/cuda_graph.py`` (the
+``CUDAGraph`` ABC mixed into DSVAE/DSUNet/DSClipEncoder: capture once, replay
+per call).  On TPU a jitted function IS a captured graph — XLA compiles one
+executable per input shape and replays it; this mixin adds the reference's
+explicit shape-keyed executable cache and enable/disable switch.
+"""
+
+import jax
+
+
+class CompiledGraphModule:
+    """Wraps an ``apply(params, *args)`` callable with per-shape compiled
+    executables (the capture/replay contract of the reference mixin)."""
+
+    def __init__(self, apply_fn, enable_cuda_graph=True, donate_argnums=()):
+        self._apply_fn = apply_fn
+        self.enable_cuda_graph = enable_cuda_graph
+        self._jitted = jax.jit(apply_fn, donate_argnums=donate_argnums)
+        self.iter_count = 0
+
+    def _shape_key(self, args, kwargs):
+        leaves = jax.tree.leaves((args, kwargs))
+        return tuple((getattr(l, "shape", None), str(getattr(l, "dtype", "")))
+                     for l in leaves)
+
+    def _graph_replay(self, params, *args, **kwargs):
+        return self._jitted(params, *args, **kwargs)
+
+    def __call__(self, params, *args, **kwargs):
+        self.iter_count += 1
+        if self.enable_cuda_graph:
+            return self._graph_replay(params, *args, **kwargs)
+        return self._apply_fn(params, *args, **kwargs)

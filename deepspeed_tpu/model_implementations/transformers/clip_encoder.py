@@ -1,0 +1,32 @@
+"""DSClipEncoder — reference
+``model_implementations/transformers/clip_encoder.py`` (``DSClipEncoder``):
+wraps a CLIP text encoder for diffusion pipelines, managing the causal mask
+and graph capture.  TPU version: shape-keyed jit replay + the CLIP-style
+additive causal mask builder the reference constructs by hand."""
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.model_implementations.features.cuda_graph import (
+    CompiledGraphModule)
+
+
+def build_causal_attention_mask(bsz, seq_len, dtype=jnp.float32):
+    """CLIP's additive causal mask (reference ``_build_causal_attention_mask``)."""
+    mask = jnp.full((seq_len, seq_len), jnp.finfo(dtype).min, dtype)
+    mask = jnp.triu(mask, k=1)
+    return jnp.broadcast_to(mask[None, None], (bsz, 1, seq_len, seq_len))
+
+
+class DSClipEncoder:
+
+    def __init__(self, enc, params=None, enable_cuda_graph=True):
+        self.enc = enc
+        self.params = params
+        self.config = getattr(enc, "config", None)
+        apply = (lambda p, ids: enc.apply(p, ids)) if hasattr(enc, "apply") \
+            else (lambda p, ids: enc(ids))
+        self._forward = CompiledGraphModule(apply, enable_cuda_graph)
+
+    def __call__(self, input_ids, params=None, **kwargs):
+        return self._forward(params if params is not None else self.params,
+                             input_ids)

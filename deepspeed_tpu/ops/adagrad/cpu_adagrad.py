@@ -1,0 +1,39 @@
+"""DeepSpeedCPUAdagrad — host (offload-tier) Adagrad, reference
+``deepspeed/ops/adagrad/cpu_adagrad.py:10`` over the SIMD kernel in
+``csrc/adagrad/cpu_adagrad.cpp`` (ours: ``ds_adagrad_step`` in
+``csrc/adam/cpu_adam.cpp``, same vectorized design, one shared library)."""
+
+import numpy as np
+
+from deepspeed_tpu.ops.adam.cpu_adam import adagrad_step
+
+
+class DeepSpeedCPUAdagrad:
+    """Stateful host Adagrad over flat fp32 master shards (API mirrors
+    ``DeepSpeedCPUAdam``: per-group in-place step with optional bf16
+    copy-out for the device upload)."""
+
+    def __init__(self, params, lr=1e-2, eps=1e-10, weight_decay=0.0):
+        self.params = [np.ascontiguousarray(p, dtype=np.float32) for p in params]
+        self.exp_avg_sq = [np.zeros_like(p) for p in self.params]
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.step_count = 0
+
+    def step(self, grads, bf16_outs=None, lr=None):
+        self.step_count += 1
+        lr = self.lr if lr is None else lr
+        for i, (p, g) in enumerate(zip(self.params, grads)):
+            out = bf16_outs[i] if bf16_outs is not None else None
+            adagrad_step(p, self.exp_avg_sq[i],
+                         np.ascontiguousarray(g, dtype=np.float32),
+                         lr, self.eps, self.weight_decay, bf16_out=out)
+
+    def state_dict(self):
+        return {"step": self.step_count, "exp_avg_sq": self.exp_avg_sq}
+
+    def load_state_dict(self, sd):
+        self.step_count = sd["step"]
+        self.exp_avg_sq = [np.ascontiguousarray(a, np.float32)
+                           for a in sd["exp_avg_sq"]]

@@ -1,0 +1,2 @@
+from deepspeed_tpu.ops.spatial.bias_add import (  # noqa: F401
+    nhwc_bias_add, nhwc_bias_add_add, nhwc_bias_add_bias_add)

@@ -1,0 +1,32 @@
+"""Spatial (diffusers) fused bias-add ops — reference
+``csrc/spatial/csrc/opt_bias_add.cu`` behind ``SpatialInferenceBuilder``:
+``nhwc_bias_add``, ``bias_add_add``, ``bias_add_bias_add`` for UNet/VAE
+residual paths.
+
+On TPU these are single XLA fusions — the value of keeping named ops is API
+parity for injected modules, plus guaranteed NHWC channel-last broadcasting
+(the reference kernels exist because torch's NCHW layout made the adds
+memory-hostile; TPU convs are NHWC-native)."""
+
+import jax
+
+
+@jax.jit
+def nhwc_bias_add(activation, bias):
+    """out = act + bias (bias broadcast over the channel-last dim)."""
+    return activation + bias.reshape((1,) * (activation.ndim - 1) + (-1,))
+
+
+@jax.jit
+def nhwc_bias_add_add(activation, bias, other):
+    """out = (act + bias) + other (residual add, reference bias_add_add)."""
+    return activation + bias.reshape((1,) * (activation.ndim - 1) + (-1,)) + other
+
+
+@jax.jit
+def nhwc_bias_add_bias_add(activation, bias, other, other_bias):
+    """out = (act + bias) + (other + other_bias) (reference
+    bias_add_bias_add — two biased tensors summed)."""
+    shape = (1,) * (activation.ndim - 1) + (-1,)
+    return (activation + bias.reshape(shape)
+            + other + other_bias.reshape(shape))

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from deepspeed_tpu.utils.logging import logger
+
 
 def pack_signs(x):
     """bool/± tensor → uint8 bitmap (1 bit per element; length padded to 8)."""
@@ -60,9 +62,10 @@ def compressed_allreduce(x, worker_error, server_error, axis):
     n_pad = chunk * W
     flat = jnp.pad(x.astype(jnp.float32).ravel(), (0, n_pad - n))
 
-    # 1-2. worker compression with error feedback
+    # 1-2. worker compression with error feedback (scale over the n REAL
+    # elements — pad zeros must not dilute it)
     buf = flat + worker_error
-    my_scale = jnp.linalg.norm(buf) / jnp.sqrt(float(n_pad))
+    my_scale = jnp.linalg.norm(buf) / jnp.sqrt(float(n))
     new_worker_error = buf - my_scale * jnp.sign(buf)
 
     # 3. chunk-wise sign exchange: worker j receives every worker's chunk j
@@ -71,12 +74,19 @@ def compressed_allreduce(x, worker_error, server_error, axis):
                           tiled=True)                      # [W, chunk/8]
     scales = lax.all_gather(my_scale, axis)                # [W]
 
-    # 4. server decode + re-compress
+    # 4. server decode + re-compress.  Pad elements (global index ≥ n, all in
+    # the last chunk) decode as +1 bits with no compensating error feedback —
+    # mask them out of the decode AND the server scale, else they bias every
+    # round (sign(0)=0 never cancels a transmitted +scale)
+    my_chunk_start = lax.axis_index(axis) * chunk
+    valid = (my_chunk_start + jnp.arange(chunk)) < n       # [chunk]
+    n_valid = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
     signs = unpack_signs(recv, chunk)                      # [W, chunk] ±1
-    decoded = jnp.mean(signs * scales[:, None], axis=0)    # mean over workers
+    decoded = jnp.where(valid,
+                        jnp.mean(signs * scales[:, None], axis=0), 0.0)
     sbuf = decoded + server_error
-    s_scale = jnp.linalg.norm(sbuf) / jnp.sqrt(float(chunk))
-    new_server_error = sbuf - s_scale * jnp.sign(sbuf)
+    s_scale = jnp.linalg.norm(sbuf) / jnp.sqrt(n_valid)
+    new_server_error = jnp.where(valid, sbuf - s_scale * jnp.sign(sbuf), 0.0)
 
     # 5. broadcast server-compressed chunks to everyone
     all_packed = lax.all_gather(pack_signs(sbuf[None, :])[0], axis)  # [W, chunk/8]
@@ -105,9 +115,16 @@ class CompressedBackend:
 
     def _buffers(self, name, n):
         """Error-feedback buffers, one row per device (sharded over the
-        compression axis so every device owns exactly its own feedback)."""
+        compression axis so every device owns exactly its own feedback).
+        A name reused at a different size resets its feedback (it is a new
+        tensor as far as the algorithm is concerned)."""
         W = self.size()
         n_pad = -(-n // W) * W
+        if name in self.worker_errors and \
+                self.worker_errors[name].shape[1] != n_pad:
+            logger.warning(f"CompressedBackend: tensor {name!r} reused with a "
+                           f"different size; resetting its error feedback")
+            del self.worker_errors[name], self.server_errors[name]
         if name not in self.worker_errors:
             from jax.sharding import NamedSharding, PartitionSpec as P
             row = NamedSharding(self.mesh, P(self.axis))

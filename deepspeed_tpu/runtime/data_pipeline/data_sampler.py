@@ -10,6 +10,8 @@ happens in memory — same semantics, host-side numpy (this never touches the
 device; batches it yields feed the jitted step).
 """
 
+import os
+
 import numpy as np
 
 
@@ -100,23 +102,92 @@ class DeepSpeedDataSampler:
 
 
 class DataAnalyzer:
-    """Offline per-sample difficulty metric computation (light analog of
-    reference ``data_sampling/data_analyzer.py``): maps a metric function
-    over a dataset and saves/loads the result."""
+    """Offline per-sample metric analysis — reference
+    ``data_sampling/data_analyzer.py`` (``DataAnalyzer``): map one or more
+    metric functions over a dataset in shardable worker passes, write
+    per-worker results, then merge into the two artifacts the curriculum
+    sampler consumes: ``<metric>_sample_to_metric`` (sample idx → value) and
+    ``<metric>_metric_to_sample`` (value → sample indices)."""
 
-    def __init__(self, dataset, metric_fn):
+    def __init__(self, dataset, metric_names=None, metric_functions=None,
+                 save_path=None, num_workers=1, worker_id=0, metric_fn=None):
         self.dataset = dataset
-        self.metric_fn = metric_fn
+        if metric_fn is not None:  # single-metric convenience form
+            metric_names = metric_names or ["metric"]
+            metric_functions = [metric_fn]
+        self.metric_names = metric_names or []
+        self.metric_functions = metric_functions or []
+        if save_path is None:
+            # convenience uses must not litter the cwd with shard files
+            import tempfile
+            save_path = tempfile.mkdtemp(prefix="dstpu_data_analyzer_")
+        self.save_path = save_path
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+
+    # -------------------------------------------------------------- #
+    def _worker_indices(self, worker_id=None):
+        w = self.worker_id if worker_id is None else worker_id
+        n = len(self.dataset)
+        per = -(-n // self.num_workers)
+        return range(w * per, min((w + 1) * per, n))
+
+    def run_map(self, worker_id=None):
+        """One worker's pass (reference ``run_map``): computes every metric
+        on this worker's shard and writes ``worker_<w>_<metric>.npy``."""
+        idxs = list(self._worker_indices(worker_id))
+        w = self.worker_id if worker_id is None else worker_id
+        os.makedirs(self.save_path, exist_ok=True)
+        out = {}
+        for name, fn in zip(self.metric_names, self.metric_functions):
+            vals = np.asarray([fn(self.dataset[i]) for i in idxs])
+            np.save(os.path.join(self.save_path, f"worker_{w}_{name}.npy"), vals)
+            out[name] = vals
+        return out
+
+    def run_reduce(self):
+        """Merge all workers' shards (reference ``run_reduce``): writes
+        ``<metric>_sample_to_metric.npy`` and ``<metric>_metric_to_sample.npz``."""
+        merged = {}
+        for name in self.metric_names:
+            parts = []
+            for w in range(self.num_workers):
+                parts.append(np.load(os.path.join(self.save_path,
+                                                  f"worker_{w}_{name}.npy")))
+            s2m = np.concatenate(parts)
+            np.save(os.path.join(self.save_path,
+                                 f"{name}_sample_to_metric.npy"), s2m)
+            m2s = {}
+            for i, v in enumerate(s2m):
+                m2s.setdefault(v.item(), []).append(i)
+            np.savez(os.path.join(self.save_path, f"{name}_metric_to_sample.npz"),
+                     **{str(k): np.asarray(v) for k, v in m2s.items()})
+            merged[name] = s2m
+        return merged
 
     def run(self):
-        return np.asarray([self.metric_fn(self.dataset[i])
-                           for i in range(len(self.dataset))])
+        """Single-process map+reduce over all workers."""
+        for w in range(self.num_workers):
+            self.run_map(worker_id=w)
+        merged = self.run_reduce()
+        return merged[self.metric_names[0]] if len(merged) == 1 else merged
 
-    def run_and_save(self, path):
+    def run_and_save(self, path=None):
         vals = self.run()
-        np.save(path, vals)
+        if path is not None:
+            np.save(path, vals)
         return vals
 
     @staticmethod
     def load(path):
         return np.load(path)
+
+    @staticmethod
+    def load_metric(save_path, metric_name):
+        """The curriculum sampler's read side."""
+        s2m = np.load(os.path.join(save_path,
+                                   f"{metric_name}_sample_to_metric.npy"))
+        with np.load(os.path.join(save_path,
+                                  f"{metric_name}_metric_to_sample.npz")) as z:
+            m2s = {k: z[k].copy() for k in z.files}
+        return s2m, m2s

@@ -1,0 +1,112 @@
+"""Memory-mapped indexed dataset — reference
+``runtime/data_pipeline/data_sampling/indexed_dataset.py`` (617 LoC,
+Megatron-LM format): a ``.bin`` of concatenated token arrays plus a ``.idx``
+with dtype/sizes/pointers, read via np.memmap so a multi-TB corpus costs no
+RAM.
+
+Format (little-endian):
+  idx:  magic ``DSTPUIDX`` | version u32 | dtype_code u8 | count u64 |
+        sizes u32[count] | pointers u64[count]
+  bin:  raw sample arrays back to back
+"""
+
+import os
+import struct
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+           5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix):
+    return prefix + ".bin"
+
+
+def index_file_path(prefix):
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """Streaming writer (reference ``MMapIndexedDatasetBuilder``)."""
+
+    def __init__(self, out_prefix, dtype=np.int32):
+        self.prefix = out_prefix
+        self.dtype = np.dtype(dtype)
+        self._bin = open(data_file_path(out_prefix), "wb")
+        self.sizes = []
+        self.pointers = []
+        self._offset = 0
+
+    def add_item(self, tokens):
+        arr = np.asarray(tokens, dtype=self.dtype)
+        self._bin.write(arr.tobytes(order="C"))
+        self.pointers.append(self._offset)
+        self.sizes.append(arr.size)
+        self._offset += arr.nbytes
+
+    def merge_file_(self, other_prefix):
+        """Append another indexed dataset (reference ``merge_file_`` used by
+        parallel preprocessing workers)."""
+        other = MMapIndexedDataset(other_prefix)
+        for i in range(len(other)):
+            self.add_item(other[i])
+
+    def finalize(self):
+        self._bin.close()
+        with open(index_file_path(self.prefix), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<I", _VERSION))
+            f.write(struct.pack("<B", _DTYPE_CODES[self.dtype]))
+            f.write(struct.pack("<Q", len(self.sizes)))
+            f.write(np.asarray(self.sizes, np.uint32).tobytes())
+            f.write(np.asarray(self.pointers, np.uint64).tobytes())
+
+
+class MMapIndexedDataset:
+    """Zero-copy reader (reference ``MMapIndexedDataset``)."""
+
+    def __init__(self, prefix):
+        with open(index_file_path(prefix), "rb") as f:
+            assert f.read(8) == _MAGIC, f"bad index magic in {prefix}.idx"
+            (version,) = struct.unpack("<I", f.read(4))
+            assert version == _VERSION
+            (code,) = struct.unpack("<B", f.read(1))
+            self.dtype = np.dtype(_DTYPES[code])
+            (count,) = struct.unpack("<Q", f.read(8))
+            self.sizes = np.frombuffer(f.read(4 * count), np.uint32)
+            self.pointers = np.frombuffer(f.read(8 * count), np.uint64)
+        self._data = np.memmap(data_file_path(prefix), mode="r", dtype=np.uint8)
+        self.prefix = prefix
+
+    def __len__(self):
+        return len(self.sizes)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        ptr, size = int(self.pointers[i]), int(self.sizes[i])
+        raw = self._data[ptr:ptr + size * self.dtype.itemsize]
+        return np.frombuffer(raw.tobytes(), dtype=self.dtype)
+
+    def get(self, idx, offset=0, length=None):
+        """Partial read (reference ``get``): ``length`` tokens from
+        ``offset`` inside sample ``idx`` — the curriculum-seqlen hook."""
+        full = self[idx]
+        end = len(full) if length is None else offset + length
+        return full[offset:end]
+
+    @property
+    def supports_prefetch(self):
+        return False  # memmap pages on demand
+
+
+def make_dataset(prefix, impl="mmap", **kw):
+    """Reference ``make_dataset`` entry point (only the mmap impl survives —
+    the others existed for pre-mmap torch versions)."""
+    assert impl in ("mmap", "infer"), f"unsupported indexed_dataset impl {impl}"
+    return MMapIndexedDataset(prefix)

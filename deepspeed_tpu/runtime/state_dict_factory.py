@@ -65,11 +65,16 @@ def get_sd_loader(ckpt_list, sd_type="Megatron", version=None):
 
 
 def _classify(name):
+    """Classify by token-boundary-anchored match: short patterns like 'wo'
+    must not fire inside unrelated names ('word_embeddings')."""
+    def hit(pat):
+        return re.search(rf"(^|[._/]){pat}([._/]|$)", name)
+
     for pat in COLUMN_PARALLEL_PATTERNS:
-        if re.search(pat, name):
+        if hit(pat):
             return "column"
     for pat in ROW_PARALLEL_PATTERNS:
-        if re.search(pat, name):
+        if hit(pat):
             return "row"
     return "replicated"
 

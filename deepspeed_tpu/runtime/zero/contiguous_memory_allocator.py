@@ -1,0 +1,127 @@
+"""Contiguous memory allocator — reference
+``runtime/zero/contiguous_memory_allocator.py`` (287 LoC): a fixed flat
+buffer carved into tensor assignments, with release + defragmentation, used
+by ZeRO-3's partial-parameter machinery to avoid allocator churn.
+
+On TPU, XLA owns device memory inside a program, but the *host-side staging
+tier* (offload buffers, swap staging) has exactly the reference's problem:
+repeated alloc/free of pinned host arenas fragments and stalls.  This is the
+same allocator over one preallocated numpy arena; tensors are views, and
+``defragment()`` compacts live assignments to the front (the reference's
+tensor-move callback maps to view re-binding)."""
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class ContiguousMemoryAllocator:
+
+    def __init__(self, size, dtype=np.float32, device="cpu"):
+        self.size = int(size)
+        self.dtype = np.dtype(dtype)
+        self.buffer = np.zeros(self.size, self.dtype)
+        self.device = device
+        # offset -> length of free blocks
+        self.contiguous_sizes = {0: self.size}
+        # tensor_id -> (offset, numel)
+        self.tensor_addresses = {}
+        self.tensor_map = {}
+        self.total_free = self.size
+        self.largest_contiguous = self.size
+        self.max_allocated = 0
+        self.count = 0
+
+    # ---------------------------------------------------------------- #
+    def allocate_tensor(self, numel):
+        """Reference ``allocate_tensor``: returns a view of ``numel``
+        elements, defragmenting first when only fragmented space remains."""
+        numel = int(numel)
+        assert numel <= self.total_free, \
+            f"allocate {numel} > free {self.total_free}"
+        if self.largest_contiguous < numel:
+            logger.info("ContiguousMemoryAllocator: defragmenting to satisfy "
+                        f"a {numel}-element request")
+            self.defragment()
+        offset = self._find_block(numel)
+        assert offset is not None
+        self._carve(offset, numel)
+        self.count += 1
+        tid = self.count
+        self.tensor_addresses[tid] = (offset, numel)
+        view = self.buffer[offset:offset + numel]
+        self.tensor_map[tid] = view
+        self.max_allocated = max(self.max_allocated,
+                                 self.size - self.total_free)
+        return tid, view
+
+    def release_tensor(self, tid):
+        offset, numel = self.tensor_addresses.pop(tid)
+        self.tensor_map.pop(tid)
+        self._free(offset, numel)
+
+    def release_tensor_with_id(self, tid):
+        self.release_tensor(tid)
+
+    def get_tensor(self, tid):
+        return self.tensor_map[tid]
+
+    # ---------------------------------------------------------------- #
+    def defragment(self):
+        """Compact live tensors to the front (reference ``defragment`` moves
+        tensors and fires an address-update callback; views re-bind here)."""
+        new_offset = 0
+        for tid in sorted(self.tensor_addresses,
+                          key=lambda t: self.tensor_addresses[t][0]):
+            offset, numel = self.tensor_addresses[tid]
+            if offset != new_offset:
+                self.buffer[new_offset:new_offset + numel] = \
+                    self.buffer[offset:offset + numel]
+                self.tensor_addresses[tid] = (new_offset, numel)
+                self.tensor_map[tid] = self.buffer[new_offset:new_offset + numel]
+            new_offset += numel
+        self.contiguous_sizes = {new_offset: self.size - new_offset} \
+            if new_offset < self.size else {}
+        self._recompute()
+
+    # ---------------------------------------------------------------- #
+    def _find_block(self, numel):
+        best = None
+        for off, length in sorted(self.contiguous_sizes.items()):
+            if length >= numel and (best is None or
+                                    length < self.contiguous_sizes[best]):
+                best = off
+        return best
+
+    def _carve(self, offset, numel):
+        length = self.contiguous_sizes.pop(offset)
+        if length > numel:
+            self.contiguous_sizes[offset + numel] = length - numel
+        self._recompute()
+
+    def _free(self, offset, numel):
+        self.contiguous_sizes[offset] = numel
+        # merge adjacent free blocks
+        merged = {}
+        for off in sorted(self.contiguous_sizes):
+            length = self.contiguous_sizes[off]
+            if merged:
+                last = max(merged)
+                if last + merged[last] == off:
+                    merged[last] += length
+                    continue
+            merged[off] = length
+        self.contiguous_sizes = merged
+        self._recompute()
+
+    def _recompute(self):
+        self.total_free = sum(self.contiguous_sizes.values())
+        self.largest_contiguous = max(self.contiguous_sizes.values()) \
+            if self.contiguous_sizes else 0
+
+    def print_allocation(self, resolution=200):
+        occupied = self.size - self.total_free
+        logger.info(
+            f"ContiguousMemoryAllocator: {occupied}/{self.size} used, "
+            f"{len(self.tensor_addresses)} tensors, largest free block "
+            f"{self.largest_contiguous}")

@@ -63,7 +63,10 @@ def safe_get_full_grad(engine, param_path):
     """Most recent full gradient for a param (reference
     ``safe_get_full_grad``); engine retains grads only between backward and
     step in the 3-call API."""
-    grads = getattr(engine, "_staged_grads", None)
+    grads = getattr(engine, "_grad_acc", None)
+    if grads is None:
+        pending = getattr(engine, "_pending", None)
+        grads = pending[0] if pending else None
     if grads is None:
         return None
     return np.asarray(jax.device_get(_lookup(grads, param_path)))

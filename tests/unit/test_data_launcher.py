@@ -1,0 +1,101 @@
+"""Tests for indexed_dataset, DataAnalyzer map/reduce, and multinode
+runners (analogs of reference tests/unit/{runtime/test_data,launcher})."""
+
+import argparse
+import sys
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    MMapIndexedDataset, MMapIndexedDatasetBuilder, make_dataset)
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import DataAnalyzer
+from deepspeed_tpu.launcher.multinode_runner import (
+    MVAPICHRunner, OpenMPIRunner, PDSHRunner, SlurmRunner, build_runner)
+
+
+def test_indexed_dataset_roundtrip(tmp_path):
+    prefix = str(tmp_path / "corpus")
+    b = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+    samples = [np.arange(n, dtype=np.int32) for n in (5, 1, 9, 3)]
+    for s in samples:
+        b.add_item(s)
+    b.finalize()
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == 4
+    for i, s in enumerate(samples):
+        np.testing.assert_array_equal(ds[i], s)
+    # partial reads (curriculum-seqlen hook)
+    np.testing.assert_array_equal(ds.get(2, offset=2, length=3), [2, 3, 4])
+    # slice protocol
+    assert len(ds[1:3]) == 2
+    assert make_dataset(prefix).dtype == np.int32
+
+
+def test_indexed_dataset_merge(tmp_path):
+    p1, p2 = str(tmp_path / "a"), str(tmp_path / "b")
+    for p, vals in ((p1, [1, 2]), (p2, [3])):
+        b = MMapIndexedDatasetBuilder(p, dtype=np.int64)
+        for v in vals:
+            b.add_item(np.full(v, v, np.int64))
+        b.finalize()
+    merged = MMapIndexedDatasetBuilder(str(tmp_path / "m"), dtype=np.int64)
+    merged.merge_file_(p1)
+    merged.merge_file_(p2)
+    merged.finalize()
+    ds = MMapIndexedDataset(str(tmp_path / "m"))
+    assert len(ds) == 3
+    np.testing.assert_array_equal(ds[2], [3, 3, 3])
+
+
+def test_data_analyzer_map_reduce(tmp_path):
+    data = [np.arange(n) for n in (3, 7, 2, 9, 5, 1)]
+    an = DataAnalyzer(data, metric_names=["seqlen"],
+                      metric_functions=[len], save_path=str(tmp_path),
+                      num_workers=3)
+    vals = an.run()
+    np.testing.assert_array_equal(vals, [3, 7, 2, 9, 5, 1])
+    s2m, m2s = DataAnalyzer.load_metric(str(tmp_path), "seqlen")
+    np.testing.assert_array_equal(s2m, [3, 7, 2, 9, 5, 1])
+    np.testing.assert_array_equal(m2s["9"], [3])
+
+
+def _args(**kw):
+    ns = argparse.Namespace(user_script="train.py", user_args=["--x", "1"],
+                            hostfile="hf", comment="")
+    for k, v in kw.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def test_multinode_runner_cmds():
+    resources = {"host1": 4, "host2": 4}
+    pdsh = PDSHRunner(_args())
+    pdsh.add_export("DSTPU_COORDINATOR_ADDRESS", "host1:29500")
+    cmd = pdsh.get_cmd({}, resources)
+    assert cmd[0] == "pdsh" and "host1,host2" in cmd
+    joined = cmd[-1]
+    assert "DSTPU_COORDINATOR_ADDRESS=host1:29500" in joined
+    assert "DSTPU_PROCESS_ID=%n" in joined and "train.py --x 1" in joined
+
+    mpi = OpenMPIRunner(_args())
+    mpi.add_export("A", "b")
+    cmd = mpi.get_cmd({}, resources)
+    assert cmd[:3] == ["mpirun", "-n", "2"] and "-x" in cmd and "A=b" in cmd
+    assert "train.py" in cmd and cmd[-2:] == ["--x", "1"]
+
+    slurm = SlurmRunner(_args())
+    slurm.add_export("E", "f")
+    cmd = slurm.get_cmd({}, resources)
+    assert cmd[0] == "srun" and "--export=ALL,E=f" in cmd
+
+    mv = build_runner("mvapich", _args())
+    assert isinstance(mv, MVAPICHRunner)
+    mv.add_export("G", "h")
+    cmd = mv.get_cmd({}, resources)
+    assert "-genv" in cmd and "-ppn" in cmd
+
+
+def test_build_runner_unknown():
+    import pytest
+    with pytest.raises(ValueError):
+        build_runner("bogus", _args())

@@ -110,6 +110,29 @@ def test_compressed_allreduce_approximates_mean(eight_devices):
     assert float(jnp.abs(be.worker_errors["g"]).sum()) > 0
 
 
+def test_compressed_allreduce_padded_tail(eight_devices):
+    """n not divisible by world×8: pad bits must not bias the last chunk
+    (pads decode as +1 sign with no error feedback unless masked)."""
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(eight_devices), ("dp",))
+    be = CompressedBackend(mesh, "dp")
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal(1001), jnp.float32)
+    exact = np.asarray(x)
+    cum = np.zeros_like(exact)
+    for i in range(1, 7):
+        cum += np.asarray(be.allreduce("t", x))
+    np.testing.assert_array_less(
+        np.linalg.norm(cum - 6 * exact) / np.linalg.norm(6 * exact), 0.5)
+    # tail elements specifically must track (they share the padded chunk)
+    tail_err = np.abs(cum[-60:] / 6 - exact[-60:]).mean()
+    head_err = np.abs(cum[:60] / 6 - exact[:60]).mean()
+    assert tail_err < 3 * head_err + 0.2, (tail_err, head_err)
+    # name reuse at a different size resets feedback instead of crashing
+    out = be.allreduce("t", jnp.asarray(rng.standard_normal(257), jnp.float32))
+    assert out.shape == (257,)
+
+
 def test_compressed_allreduce_unbiased_over_workers(eight_devices):
     """With different per-worker tensors (sharded batch axis), the decoded
     mean must correlate strongly with the true mean."""

@@ -54,6 +54,27 @@ def initialize(args=None,
         config = args.deepspeed_config
     assert config is not None, "DeepSpeed requires --deepspeed_config or config="
 
+    if topology is None and mpu is not None:
+        # honor an external Megatron-style mpu (reference __init__.py:88:
+        # the engine adopts mpu's groups) by building the mesh from its
+        # parallel degrees
+        from deepspeed_tpu.parallel import topology as _topo
+
+        def _mpu_size(*names):
+            for n in names:
+                fn = getattr(mpu, n, None)
+                if callable(fn):
+                    return fn()
+            return 1
+
+        # probe both naming schemes: legacy Megatron (model_parallel) and
+        # Megatron-Core (tensor_model_parallel / pipeline_model_parallel)
+        tp_size = _mpu_size("get_model_parallel_world_size",
+                            "get_tensor_model_parallel_world_size")
+        pp_size = _mpu_size("get_pipe_parallel_world_size",
+                            "get_pipeline_model_parallel_world_size")
+        topology = _topo.initialize_topology(tp=tp_size, pp=pp_size)
+
     from deepspeed_tpu.runtime.pipe.module import PipelineModule
     if isinstance(model, PipelineModule):
         from deepspeed_tpu.runtime.pipe.engine import PipelineEngine

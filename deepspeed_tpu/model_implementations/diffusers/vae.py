@@ -22,10 +22,13 @@ class DSVAE:
         self.vae = vae
         self.params = params
         self.config = getattr(vae, "config", None)
+        is_flax = hasattr(vae, "apply")
         if encode_fn is None and hasattr(vae, "encode"):
-            encode_fn = lambda p, x: vae.apply(p, x, method=type(vae).encode)
+            encode_fn = (lambda p, x: vae.apply(p, x, method=type(vae).encode)) \
+                if is_flax else (lambda p, x: vae.encode(x))
         if decode_fn is None and hasattr(vae, "decode"):
-            decode_fn = lambda p, x: vae.apply(p, x, method=type(vae).decode)
+            decode_fn = (lambda p, x: vae.apply(p, x, method=type(vae).decode)) \
+                if is_flax else (lambda p, x: vae.decode(x))
         fwd_fn = (lambda p, x: vae.apply(p, x)) if hasattr(vae, "apply") \
             else (lambda p, x: vae(x))
         self._encode = CompiledGraphModule(encode_fn, enable_cuda_graph) \

@@ -11,19 +11,16 @@ import jax
 
 
 class CompiledGraphModule:
-    """Wraps an ``apply(params, *args)`` callable with per-shape compiled
-    executables (the capture/replay contract of the reference mixin)."""
+    """Wraps an ``apply(params, *args)`` callable in the capture/replay
+    contract of the reference mixin.  jax.jit itself keys compiled
+    executables by input shape/dtype, so replay is one dispatch per call and
+    capture happens implicitly on the first call per shape."""
 
     def __init__(self, apply_fn, enable_cuda_graph=True, donate_argnums=()):
         self._apply_fn = apply_fn
         self.enable_cuda_graph = enable_cuda_graph
         self._jitted = jax.jit(apply_fn, donate_argnums=donate_argnums)
         self.iter_count = 0
-
-    def _shape_key(self, args, kwargs):
-        leaves = jax.tree.leaves((args, kwargs))
-        return tuple((getattr(l, "shape", None), str(getattr(l, "dtype", "")))
-                     for l in leaves)
 
     def _graph_replay(self, params, *args, **kwargs):
         return self._jitted(params, *args, **kwargs)

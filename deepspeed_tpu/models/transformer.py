@@ -58,6 +58,10 @@ class TransformerConfig:
     dtype: str = "bfloat16"
     use_flash_attention: bool = True
     sparse_attention: Optional[object] = None  # SparsityConfig → block-sparse
+    # "ulysses" | "ring" routes training attention through explicit
+    # sequence-parallel collectives over the live sp mesh axis; None leaves
+    # seq sharding to GSPMD constraint propagation
+    sequence_parallel_impl: Optional[str] = None
     remat: bool = True
     remat_policy: str = "nothing_saveable"
     scan_layers: bool = True
@@ -220,6 +224,19 @@ def _attention(q, k, v, config, mask=None, bias=None):
                 v = jnp.repeat(v, q.shape[2] // v.shape[2], axis=2)
             return block_sparse_attention(q, k, v, layout, sc.block,
                                           causal=True, key_padding_mask=mask)
+    if config.sequence_parallel_impl and q.shape[1] > 1 and mask is None \
+            and bias is None:
+        from deepspeed_tpu.parallel.topology import get_topology
+        topo = get_topology()
+        if topo is not None and topo.get_sequence_parallel_world_size() > 1:
+            from deepspeed_tpu.parallel.sequence import shard_map_attention
+            if k.shape[2] != q.shape[2]:  # GQA: expand for the sp kernels
+                k = jnp.repeat(k, q.shape[2] // k.shape[2], axis=2)
+                v = jnp.repeat(v, q.shape[2] // v.shape[2], axis=2)
+            fn = shard_map_attention(topo.mesh,
+                                     impl=config.sequence_parallel_impl,
+                                     axis="sp", causal=True)
+            return fn(q, k, v)
     if config.use_flash_attention and q.shape[1] > 1 and mask is None \
             and bias is None:
         from deepspeed_tpu.ops.transformer.flash_attention import (

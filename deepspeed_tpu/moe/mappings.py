@@ -1,0 +1,65 @@
+"""TP↔EP tensor redistributions — reference ``deepspeed/moe/mappings.py``
+(``_GatherTokens``/``_DropTokens`` autograd functions over the tensor-model-
+parallel group).
+
+Why they exist: with TP active, every TP rank holds the same tokens; sending
+all of them through the expert all_to_all would route duplicates.  The
+reference drops 1/tp of the tokens before MoE dispatch and gathers them back
+after.  Here the pair are differentiable functions usable inside
+``shard_map`` over a mesh axis — the vjp of a gather is a drop and vice
+versa, so jax.grad recreates the reference's custom backward passes.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _axis_size(axis):
+    return lax.psum(1, axis)
+
+
+def _gather(x, axis, dim):
+    return lax.all_gather(x, axis, axis=dim, tiled=True)
+
+
+def _drop(x, axis, dim):
+    W = _axis_size(axis)
+    r = lax.axis_index(axis)
+    size = x.shape[dim] // W
+    return lax.dynamic_slice_in_dim(x, r * size, size, axis=dim)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_tokens(x, axis="tp", dim=0):
+    return _gather(x, axis, dim)
+
+
+def _gather_fwd(x, axis, dim):
+    return _gather(x, axis, dim), None
+
+
+def _gather_bwd(axis, dim, _res, g):
+    return (_drop(g, axis, dim),)
+
+
+gather_tokens.defvjp(_gather_fwd, _gather_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def drop_tokens(x, axis="tp", dim=0):
+    """Keep this rank's 1/tp slice of ``dim`` (reference ``_DropTokens``)."""
+    return _drop(x, axis, dim)
+
+
+def _drop_fwd(x, axis, dim):
+    return _drop(x, axis, dim), None
+
+
+def _drop_bwd(axis, dim, _res, g):
+    return (_gather(g, axis, dim),)
+
+
+drop_tokens.defvjp(_drop_fwd, _drop_bwd)

@@ -1,0 +1,64 @@
+"""MoE utilities — reference ``deepspeed/moe/utils.py``:
+``has_moe_layers``, ``is_moe_param``, and
+``split_params_into_different_moe_groups_for_optimizer`` (expert params get
+their own optimizer group so expert grads average over expert-data-parallel
+only, reference ``stage_1_and_2.py:1781``).
+
+On TPU, expert params are identified by tree path (the sharding planner uses
+the same convention, ``runtime/zero/partition.py`` EXPERT_PARAM_PATTERN), and
+"groups" are path-predicate partitions of the param pytree.
+"""
+
+import re
+
+import jax
+
+EXPERT_PATTERN = r"(^|[/.])experts?([/._]|$)|expert_"
+
+
+def is_moe_param_path(path):
+    return re.search(EXPERT_PATTERN, path.lower()) is not None
+
+
+def is_moe_param(path_or_leaf, path=None):
+    """Reference ``is_moe_param``: torch checks ``param.allreduce is False``;
+    here identity is the tree path."""
+    p = path_or_leaf if isinstance(path_or_leaf, str) else path
+    return p is not None and is_moe_param_path(p)
+
+
+def has_moe_layers(params):
+    """True if any param path looks expert-partitioned (reference checks for
+    MoE modules on the torch module tree)."""
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    return any(is_moe_param_path(_path_str(p)) for p, _ in flat)
+
+
+def _path_str(path):
+    parts = []
+    for p in path:
+        parts.append(str(getattr(p, "key", getattr(p, "idx", p))))
+    return "/".join(parts)
+
+
+def split_params_into_different_moe_groups_for_optimizer(params):
+    """Partition a param pytree into (dense_mask, expert_mask) boolean trees
+    (reference returns split torch param groups).  Masks feed optimizers
+    that need per-group treatment (e.g. expert-lr or grad-averaging groups)."""
+    dense = jax.tree_util.tree_map_with_path(
+        lambda p, _: not is_moe_param_path(_path_str(p)), params)
+    expert = jax.tree.map(lambda d: not d, dense)
+    return dense, expert
+
+
+def split_params_grads_into_shared_and_expert_params(grads):
+    """Reference helper of the same name: zero out the complementary part of
+    each split so both pytrees keep the full structure."""
+    import jax.numpy as jnp
+    shared = jax.tree_util.tree_map_with_path(
+        lambda p, g: g if not is_moe_param_path(_path_str(p))
+        else jnp.zeros_like(g), grads)
+    expert = jax.tree_util.tree_map_with_path(
+        lambda p, g: g if is_moe_param_path(_path_str(p))
+        else jnp.zeros_like(g), grads)
+    return shared, expert

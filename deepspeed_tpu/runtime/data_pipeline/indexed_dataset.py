@@ -51,10 +51,22 @@ class MMapIndexedDatasetBuilder:
 
     def merge_file_(self, other_prefix):
         """Append another indexed dataset (reference ``merge_file_`` used by
-        parallel preprocessing workers)."""
+        parallel preprocessing workers) — a single streamed byte copy of the
+        .bin plus offset-shifted index arithmetic, no per-sample decode."""
         other = MMapIndexedDataset(other_prefix)
-        for i in range(len(other)):
-            self.add_item(other[i])
+        assert other.dtype == self.dtype, \
+            f"dtype mismatch merging {other_prefix}: " \
+            f"{other.dtype} vs builder {self.dtype}"
+        base = self._offset
+        with open(data_file_path(other_prefix), "rb") as src:
+            while True:
+                buf = src.read(16 << 20)
+                if not buf:
+                    break
+                self._bin.write(buf)
+                self._offset += len(buf)
+        self.sizes.extend(int(s) for s in other.sizes)
+        self.pointers.extend(base + int(p) for p in other.pointers)
 
     def finalize(self):
         self._bin.close()

@@ -1,0 +1,135 @@
+"""FP16_Optimizer — standalone mixed-precision optimizer wrapper.
+
+Reference parity: ``runtime/fp16/fused_optimizer.py:22`` (``FP16_Optimizer``):
+fp32 master weights + dynamic loss scaling + global-norm clipping around an
+inner fused optimizer, with the 3-call contract
+``backward(loss) → step()`` and overflow-skip semantics.
+
+TPU redesign: the engine's fused train step subsumes this in production; the
+standalone class exists for reference-API users and tests.  State is
+functional (masters, inner opt state, scaler state) and every step is one
+jitted program; on overflow the update is a branch-free no-op, exactly like
+the engine path.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.fp16.loss_scaler import (DynamicLossScaler,
+                                                    LossScalerState,
+                                                    StaticLossScaler)
+
+
+class FP16_Optimizer:
+
+    def __init__(self, init_optimizer, params=None, static_loss_scale=None,
+                 dynamic_loss_scale=True, initial_dynamic_scale=2**16,
+                 dynamic_loss_args=None, clip_grad=0.0, verbose=False,
+                 mpu=None, fused_adam_legacy=False, timers=None):
+        self.optimizer = init_optimizer
+        self.clip_grad = float(clip_grad or 0.0)
+        if dynamic_loss_scale and static_loss_scale is None:
+            args = dynamic_loss_args or {}
+            self.loss_scaler = DynamicLossScaler(
+                init_scale=initial_dynamic_scale, **args)
+        else:
+            self.loss_scaler = StaticLossScaler(static_loss_scale or 1.0)
+        self.fp32_groups_flat = None   # master params (pytree)
+        self.opt_state = None
+        self.scaler_state = self.loss_scaler.init()
+        self.overflow = False
+        self.step_count = 0
+        self._pending_grads = None
+        if params is not None:
+            self.initialize_masters(params)
+
+    # -------------------------------------------------------------- #
+    def initialize_masters(self, fp16_params):
+        self.fp32_groups_flat = jax.tree.map(
+            lambda p: jnp.asarray(p, jnp.float32), fp16_params)
+        self.opt_state = self.optimizer.init(self.fp32_groups_flat)
+
+    @property
+    def cur_scale(self):
+        return float(self.scaler_state.scale)
+
+    def scale_loss(self, loss):
+        """Multiply the loss by the live scale before differentiation (the
+        functional analog of reference ``backward(loss)``'s scaled
+        ``loss.backward()``)."""
+        return loss * self.scaler_state.scale
+
+    def backward(self, grads_of_scaled_loss):
+        """Stage the (scaled) grads for ``step`` (reference computes them via
+        autograd; jax hands them to us)."""
+        self._pending_grads = grads_of_scaled_loss
+
+    # -------------------------------------------------------------- #
+    def _step_fn(self):
+        clip = self.clip_grad
+        scaler = self.loss_scaler
+        opt = self.optimizer
+
+        def step(masters, opt_state, scaler_state, grads, step_no):
+            inv = 1.0 / scaler_state.scale
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+            flat = jax.tree.leaves(grads)
+            found_inf = jnp.logical_not(jnp.all(
+                jnp.stack([jnp.all(jnp.isfinite(g)) for g in flat])))
+            gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in flat))
+            if clip > 0:
+                factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * factor, grads)
+            new_masters, new_opt = opt.update(grads, opt_state, masters,
+                                              step=step_no)
+            keep = lambda new, old: jax.tree.map(
+                lambda n, o: jnp.where(found_inf, o, n), new, old)
+            return (keep(new_masters, masters), keep(new_opt, opt_state),
+                    scaler.update(scaler_state, found_inf), found_inf, gnorm)
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def step(self, closure=None):
+        assert self._pending_grads is not None, "backward() not called"
+        assert self.fp32_groups_flat is not None, \
+            "initialize_masters() not called"
+        if not hasattr(self, "_jitted_step"):
+            self._jitted_step = self._step_fn()
+        self.step_count += 1
+        (self.fp32_groups_flat, self.opt_state, self.scaler_state,
+         found_inf, self._last_norm) = self._jitted_step(
+            self.fp32_groups_flat, self.opt_state, self.scaler_state,
+            self._pending_grads, jnp.asarray(self.step_count, jnp.int32))
+        self._pending_grads = None
+        self.overflow = bool(jax.device_get(found_inf))
+        return self.overflow
+
+    # -------------------------------------------------------------- #
+    def get_fp16_params(self):
+        """Current working (half) weights derived from the masters."""
+        return jax.tree.map(lambda p: p.astype(jnp.float16),
+                            self.fp32_groups_flat)
+
+    def state_dict(self):
+        return {
+            "step": self.step_count,
+            "fp32_groups_flat": jax.device_get(self.fp32_groups_flat),
+            "optimizer_state": jax.device_get(self.opt_state),
+            "loss_scaler": jax.device_get(self.scaler_state),
+            "overflow": self.overflow,
+        }
+
+    def load_state_dict(self, sd, load_optimizer_states=True):
+        self.step_count = sd["step"]
+        self.fp32_groups_flat = jax.tree.map(jnp.asarray,
+                                             sd["fp32_groups_flat"])
+        if load_optimizer_states and sd.get("optimizer_state") is not None:
+            opt = sd["optimizer_state"]
+            if self.opt_state is not None and hasattr(self.opt_state, "_fields") \
+                    and isinstance(opt, dict):
+                opt = type(self.opt_state)(**opt)
+            self.opt_state = opt
+        sc = sd.get("loss_scaler")
+        if sc is not None:
+            self.scaler_state = sc if isinstance(sc, LossScalerState) else \
+                LossScalerState(*sc)

@@ -1,0 +1,44 @@
+"""FP16_UnfusedOptimizer — reference ``runtime/fp16/unfused_optimizer.py:23``:
+the per-tensor (non-multi-tensor-apply) variant of FP16_Optimizer, kept for
+optimizers without fused kernels.
+
+On TPU the fused/unfused distinction dissolves — XLA fuses the per-leaf
+update loop either way — so this subclass differs only in applying updates
+leaf-by-leaf with per-leaf overflow short-circuiting (norm clipping per
+group, reference behavior), and exists for API parity.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.fp16.fused_optimizer import FP16_Optimizer
+
+
+class FP16_UnfusedOptimizer(FP16_Optimizer):
+
+    def _step_fn(self):
+        clip = self.clip_grad
+        scaler = self.loss_scaler
+        opt = self.optimizer
+
+        def step(masters, opt_state, scaler_state, grads, step_no):
+            inv = 1.0 / scaler_state.scale
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+            # per-leaf norms and clipping (the reference clips per group)
+            found_inf = jnp.logical_not(jnp.all(jnp.stack(
+                [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)])))
+            if clip > 0:
+                grads = jax.tree.map(
+                    lambda g: g * jnp.minimum(
+                        1.0, clip / (jnp.linalg.norm(g.ravel()) + 1e-6)),
+                    grads)
+            gnorm = jnp.sqrt(sum(jnp.sum(g * g)
+                                 for g in jax.tree.leaves(grads)))
+            new_masters, new_opt = opt.update(grads, opt_state, masters,
+                                              step=step_no)
+            keep = lambda new, old: jax.tree.map(
+                lambda n, o: jnp.where(found_inf, o, n), new, old)
+            return (keep(new_masters, masters), keep(new_opt, opt_state),
+                    scaler.update(scaler_state, found_inf), found_inf, gnorm)
+
+        return jax.jit(step, donate_argnums=(0, 1))

@@ -1,0 +1,167 @@
+"""Runtime utilities — reference ``deepspeed/runtime/utils.py`` (the
+grab-bag the engine and ZeRO lean on: ``see_memory_usage``,
+``clip_grad_norm_``, ``get_global_norm``, ``CheckOverflow``,
+``call_to_str``, ``get_grad_norm``…).
+
+Functional JAX forms: norm/clip/overflow take and return pytrees and are
+jit-safe (they are exactly what the engine's fused step inlines)."""
+
+import gc
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+
+# ------------------------------------------------------------------ #
+# norms / clipping / overflow (jit-safe)
+# ------------------------------------------------------------------ #
+def get_grad_norm(grads, norm_type=2):
+    """Global norm over a grad pytree (reference ``get_grad_norm``)."""
+    leaves = [g.astype(jnp.float32) for g in jax.tree.leaves(grads)]
+    if norm_type == float("inf"):
+        return jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in leaves]))
+    return jnp.sqrt(sum(jnp.sum(g * g) for g in leaves))
+
+
+def get_global_norm(norm_list):
+    """Combine per-group norms (reference ``get_global_norm``)."""
+    arr = jnp.stack([jnp.asarray(n, jnp.float32) for n in norm_list])
+    return jnp.sqrt(jnp.sum(arr * arr))
+
+
+def clip_grad_norm_(grads, max_norm, norm_type=2):
+    """Scale grads so the global norm ≤ max_norm; returns (grads, norm)
+    (reference ``clip_grad_norm_`` — functional, no in-place mutation)."""
+    norm = get_grad_norm(grads, norm_type)
+    factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda g: g * factor, grads), norm
+
+
+class CheckOverflow:
+    """Reference ``CheckOverflow``: has-inf/nan over grads, optionally
+    reduced across the mesh (GSPMD makes the reduction implicit when the
+    check runs inside the jitted step)."""
+
+    def __init__(self, param_groups=None, mpu=None, zero_reduce_scatter=False,
+                 deepspeed=None):
+        self.params = param_groups
+
+    @staticmethod
+    def has_overflow(grads):
+        flat = jax.tree.leaves(grads)
+        if not flat:
+            return jnp.asarray(False)
+        return jnp.logical_not(jnp.all(
+            jnp.stack([jnp.all(jnp.isfinite(g)) for g in flat])))
+
+    @staticmethod
+    def check_using_norm(norm_list):
+        total = float(np.sum(np.asarray(norm_list)))
+        return not np.isfinite(total)
+
+
+# ------------------------------------------------------------------ #
+# memory reporting
+# ------------------------------------------------------------------ #
+def memory_status(msg=""):
+    return see_memory_usage(msg, force=True)
+
+
+def see_memory_usage(message, force=False):
+    """Device + host memory dump (reference ``see_memory_usage``)."""
+    if not force and os.environ.get("DSTPU_MEMORY_DEBUG", "0") != "1":
+        return
+    lines = [message]
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats() or {}
+            used = stats.get("bytes_in_use", 0)
+            limit = stats.get("bytes_limit", 0)
+            lines.append(f"  {d}: {used / 2**30:.2f}GB used"
+                         + (f" / {limit / 2**30:.2f}GB" if limit else ""))
+        except Exception:
+            lines.append(f"  {d}: memory stats unavailable")
+    try:
+        import resource
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**20
+        lines.append(f"  host max RSS: {rss:.2f}GB")
+    except Exception:
+        pass
+    logger.info("\n".join(lines))
+
+
+def empty_cache():
+    """Best-effort allocation reclaim (reference calls torch empty_cache)."""
+    gc.collect()
+
+
+# ------------------------------------------------------------------ #
+# misc
+# ------------------------------------------------------------------ #
+def call_to_str(base, *args, **kwargs):
+    """Pretty call formatting (reference ``call_to_str``, used by pipeline
+    instruction reprs)."""
+    name = f"{base}("
+    if args:
+        name += ", ".join(repr(a) for a in args)
+        if kwargs:
+            name += ", "
+    if kwargs:
+        name += ", ".join(f"{k}={v!r}" for k, v in kwargs.items())
+    return name + ")"
+
+
+def partition_uniform(num_items, num_parts):
+    """Balanced contiguous partition bounds (reference ``partition_uniform``,
+    used by pipeline layer assignment)."""
+    parts = [0] * (num_parts + 1)
+    chunk, extra = divmod(num_items, num_parts)
+    for p in range(num_parts):
+        parts[p + 1] = parts[p] + chunk + (1 if p < extra else 0)
+    return parts
+
+
+def partition_balanced(weights, num_parts):
+    """Weight-balanced contiguous partition (reference
+    ``partition_balanced`` via prefix sums + binary search)."""
+    prefix = np.concatenate([[0], np.cumsum(np.asarray(weights, np.float64))])
+    total = prefix[-1]
+    bounds = [0]
+    for p in range(1, num_parts):
+        target = total * p / num_parts
+        bounds.append(int(np.searchsorted(prefix, target)))
+    bounds.append(len(weights))
+    # enforce monotonicity in degenerate cases
+    for i in range(1, len(bounds)):
+        bounds[i] = max(bounds[i], bounds[i - 1])
+    return bounds
+
+
+class PartitionedTensor:
+    """Reference ``PartitionedTensor`` (pipeline's activation-partition
+    helper): split a tensor across a group, reassemble on demand — the jax
+    form keeps the parts as a list plus metadata."""
+
+    def __init__(self, tensor=None, num_parts=1, parts=None, orig_shape=None):
+        if tensor is not None:
+            flat = jnp.ravel(tensor)
+            pad = (-flat.size) % num_parts
+            flat = jnp.pad(flat, (0, pad))
+            self.parts = list(jnp.split(flat, num_parts))
+            self.orig_shape = tensor.shape
+        else:
+            self.parts = parts
+            self.orig_shape = orig_shape
+
+    def to_meta(self):
+        return {"orig_shape": self.orig_shape, "num_parts": len(self.parts)}
+
+    def full(self):
+        flat = jnp.concatenate(self.parts)
+        n = int(np.prod(self.orig_shape))
+        return flat[:n].reshape(self.orig_shape)

@@ -1,0 +1,35 @@
+"""Profiler range annotation — reference ``deepspeed/utils/nvtx.py``
+(``instrument_w_nvtx`` wrapping hot functions in NVTX ranges).
+
+TPU analog: ``jax.profiler.TraceAnnotation`` ranges show up in the XLA/xprof
+trace exactly where NVTX ranges show up in nsys."""
+
+import functools
+
+import jax
+
+
+def instrument_w_nvtx(func):
+    """Decorator: record ``func``'s span in profiler traces."""
+
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        with jax.profiler.TraceAnnotation(func.__qualname__):
+            return func(*args, **kwargs)
+
+    return wrapped
+
+
+def range_push(name):
+    """Imperative range open (reference ``accelerator.range_push``)."""
+    ann = jax.profiler.TraceAnnotation(name)
+    ann.__enter__()
+    _stack.append(ann)
+
+
+def range_pop():
+    if _stack:
+        _stack.pop().__exit__(None, None, None)
+
+
+_stack = []

@@ -69,7 +69,13 @@ def safe_get_full_grad(engine, param_path):
         grads = pending[0] if pending else None
     if grads is None:
         return None
-    return np.asarray(jax.device_get(_lookup(grads, param_path)))
+    leaf = _lookup(grads, param_path)
+    # staged grads are of (loss × scale / gas) — unscale so the caller sees
+    # the true gradient the optimizer will consume after its own unscale
+    scaler = getattr(engine, "_scaler_state", None)
+    if scaler is not None:
+        leaf = leaf / scaler.scale
+    return np.asarray(jax.device_get(leaf))
 
 
 def get_local_fragment(array):

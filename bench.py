@@ -31,7 +31,8 @@ def main():
     n_dev = jax.device_count()
 
     cfg = opt_config(model_name, max_seq_len=seq, dtype="bfloat16",
-                     remat=True, remat_policy="dots_and_attn_saveable")
+                     remat=True, remat_policy="dots_and_attn_saveable",
+                     scan_layers=False)
     model = deepspeed_tpu.models.transformer.Transformer(cfg)
     engine, *_ = deepspeed_tpu.initialize(
         model=model,

@@ -233,9 +233,14 @@ def _attention(q, k, v, config, mask=None, bias=None):
             if k.shape[2] != q.shape[2]:  # GQA: expand for the sp kernels
                 k = jnp.repeat(k, q.shape[2] // k.shape[2], axis=2)
                 v = jnp.repeat(v, q.shape[2] // v.shape[2], axis=2)
+            batch_axes = tuple(a for a in topo.get_data_parallel_axes()
+                               if topo.mesh.shape[a] > 1) or None
+            head_axes = "tp" if topo.mesh.shape.get("tp", 1) > 1 else None
             fn = shard_map_attention(topo.mesh,
                                      impl=config.sequence_parallel_impl,
-                                     axis="sp", causal=True)
+                                     axis="sp", causal=True,
+                                     batch_axes=batch_axes,
+                                     head_axes=head_axes)
             return fn(q, k, v)
     if config.use_flash_attention and q.shape[1] > 1 and mask is None \
             and bias is None:

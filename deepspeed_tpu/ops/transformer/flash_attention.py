@@ -32,6 +32,8 @@ import os as _os
 # VPU-bound online-softmax bookkeeping (see bench sweep in commit message)
 DEFAULT_BLOCK_Q = int(_os.environ.get("DSTPU_FLASH_BLOCK_Q", "256"))
 DEFAULT_BLOCK_K = int(_os.environ.get("DSTPU_FLASH_BLOCK_K", "2048"))
+DEFAULT_BLOCK_Q_BWD = int(_os.environ.get("DSTPU_FLASH_BLOCK_Q_BWD", "1024"))
+DEFAULT_BLOCK_K_BWD = int(_os.environ.get("DSTPU_FLASH_BLOCK_K_BWD", "1024"))
 NEG_INF = -1e30
 # LSE/delta row vectors carry a small broadcast trailing dim: Mosaic requires
 # the last block dim be 128-divisible OR equal to the full array dim, so an
@@ -340,12 +342,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, res, do):
+def _bwd(scale, causal, block_q, block_k, block_q_bwd, block_k_bwd, res, do):
     q, k, v, out, lse = res
     B, H, S, D = q.shape
     KVH, Sk = k.shape[1], k.shape[2]
-    block_q = min(block_q, S)
-    block_k = min(block_k, Sk)
+    block_q = min(block_q_bwd, S)
+    block_k = min(block_k_bwd, Sk)
     nq = pl.cdiv(S, block_q)
     nk = pl.cdiv(Sk, block_k)
 
@@ -437,13 +439,15 @@ def _bwd(scale, causal, block_q, block_k, res, do):
 # --------------------------------------------------------------------- #
 # Public API
 # --------------------------------------------------------------------- #
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_bhsd(q, k, v, scale, causal, block_q, block_k,
+                block_q_bwd, block_k_bwd):
     out, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
     return out
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k,
+                    block_q_bwd, block_k_bwd):
     out, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
     # tag residuals so a remat policy can elect to SAVE them — without the
     # tags, any rematerialized layer re-runs the whole forward kernel inside
@@ -460,19 +464,28 @@ _flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
 
 
 def flash_attention(q, k, v, causal=True, scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    block_q_bwd=None, block_k_bwd=None):
     """Flash attention on [B, S, H, D] tensors (model-native layout).
 
     ``k``/``v`` may have fewer heads (GQA).  Returns [B, S, H, D].
+    The backward kernels tile independently (their accumulators iterate the
+    opposite grid dim; v5e sweep favors 1024x1024 there): ``block_q_bwd`` /
+    ``block_k_bwd`` default from DSTPU_FLASH_BLOCK_{Q,K}_BWD.
     """
     B, S, H, D = q.shape
     if scale is None:
         scale = 1.0 / float(np.sqrt(D))
+    if block_q_bwd is None:
+        block_q_bwd = DEFAULT_BLOCK_Q_BWD
+    if block_k_bwd is None:
+        block_k_bwd = DEFAULT_BLOCK_K_BWD
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     out = _flash_bhsd(qt, kt, vt, float(scale), bool(causal),
-                      int(block_q), int(block_k))
+                      int(block_q), int(block_k),
+                      int(block_q_bwd), int(block_k_bwd))
     return out.transpose(0, 2, 1, 3)
 
 

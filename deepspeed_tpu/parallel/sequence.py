@@ -147,10 +147,16 @@ def sequence_parallel_attention(q, k, v, impl="ulysses", axis="sp",
                      "(choices: ulysses, ring)")
 
 
-def shard_map_attention(mesh, impl="ulysses", axis="sp", causal=True):
+def shard_map_attention(mesh, impl="ulysses", axis="sp", causal=True,
+                        batch_axes=None, head_axes=None):
     """Build a [B, S, H, D] → [B, S, H, D] function where S is sharded over
     ``axis`` of ``mesh`` — the entry point for model integration (callable
-    under jit; XLA sees the collectives explicitly)."""
+    under jit; XLA sees the collectives explicitly).
+
+    ``batch_axes``/``head_axes``: mesh axes the batch / head dims are sharded
+    over (dp, tp).  Declaring them keeps shard_map from all-gathering the
+    dp-sharded batch onto every device — each device computes only its own
+    batch/head shard, with collectives riding the sp axis alone."""
     import inspect
     from jax.sharding import PartitionSpec as P
     try:
@@ -168,7 +174,7 @@ def shard_map_attention(mesh, impl="ulysses", axis="sp", causal=True):
 
     axis_size = int(np.prod([mesh.shape[a] for a in
                              ((axis,) if isinstance(axis, str) else axis)]))
-    spec = P(None, axis)
+    spec = P(batch_axes, axis, head_axes, None)
 
     def local(q, k, v):
         return sequence_parallel_attention(q, k, v, impl=impl, axis=axis,

@@ -1,0 +1,65 @@
+"""Coalesced collectives — reference
+``runtime/comm/coalesced_collectives.py:29`` (``reduce_scatter_coalesced``,
+the batched reduce-scatter ZeRO-3 grad reduction rides on).
+
+On TPU, XLA already coalesces collectives it can prove adjacent, but an
+explicit coalesced form still helps when many small tensors reduce together
+(one fused collective instead of N): flatten every tensor into one padded
+buffer, reduce-scatter once over the axis, and hand each rank its shard
+views.  Callable inside ``shard_map``.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def reduce_scatter_coalesced(tensors, axis):
+    """Reduce-scatter a list of tensors in ONE collective.
+
+    Each input is this device's full copy.  Returns a list of 1-D shards:
+    rank r's view of each tensor's r-th partition (tensor flattened and
+    padded to the axis size), matching the reference's output contract.
+    """
+    W = lax.psum(1, axis)
+    numels = [int(np.prod(t.shape)) for t in tensors]
+    padded = [-(-n // W) * W for n in numels]
+    # reduce in the widest participating dtype, hand back per-tensor dtypes
+    # (the reference preserves input dtype — bf16 grads stay bf16 on the wire)
+    acc_dtype = jnp.result_type(*[t.dtype for t in tensors])
+    flat = jnp.concatenate(
+        [jnp.pad(t.astype(acc_dtype).ravel(), (0, p - n))
+         for t, n, p in zip(tensors, numels, padded)])
+    # lay out as [W, total/W] so scatter dim 0 hands rank r one row of every
+    # tensor: interleave per-tensor partitions
+    parts = []
+    offset = 0
+    for p in padded:
+        seg = flat[offset:offset + p].reshape(W, p // W)
+        parts.append(seg)
+        offset += p
+    stacked = jnp.concatenate(parts, axis=1)          # [W, sum(p)/W]
+    # untiled psum_scatter: [W, c] in → [c] out (rank r keeps summed row r)
+    reduced = lax.psum_scatter(stacked, axis, scatter_dimension=0)
+    # split back into per-tensor shards, each in its input dtype
+    out, offset = [], 0
+    for t, p in zip(tensors, padded):
+        out.append(reduced[offset:offset + p // W].astype(t.dtype))
+        offset += p // W
+    return out
+
+
+def all_gather_coalesced(shards, axis):
+    """Inverse companion (reference pairs this with the ZeRO-3 param
+    gather): one all_gather for a list of per-rank shards; returns each
+    tensor's full flat (padded) buffer."""
+    widths = [s.shape[0] for s in shards]
+    flat = jnp.concatenate(shards)
+    gathered = lax.all_gather(flat, axis, tiled=False)   # [W, sum(w)]
+    out, offset = [], 0
+    for w in widths:
+        out.append(gathered[:, offset:offset + w].ravel())
+        offset += w
+    return out

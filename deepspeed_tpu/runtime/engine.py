@@ -683,6 +683,14 @@ class DeepSpeedEngine:
             gas = self.gradient_accumulation_steps()
             clip = float(self.gradient_clipping() or 0.0)
             scaler = self.loss_scaler
+            # bf16/fp32 run a static UNIT scale: the overflow check (a full
+            # pass over every gradient), the where-select rollback, and the
+            # scaler update are dead weight — compile them out.  An explicit
+            # fp16 static loss_scale != 1 still needs unscaling AND the
+            # overflow skip, so only scale==1.0 takes the fast path.
+            from deepspeed_tpu.runtime.fp16.loss_scaler import StaticLossScaler
+            static_scale = isinstance(scaler, StaticLossScaler) and \
+                float(scaler.scale_value) == 1.0
 
             def train_step(params, opt_state, scaler_state, lr, step, rng, batches):
                 # derive this step's stream on-device: the caller passes the
@@ -699,23 +707,36 @@ class DeepSpeedEngine:
                         return loss.astype(jnp.float32) * scaler_state.scale / gas, loss
 
                     grads, loss = jax.grad(loss_of, has_aux=True)(params)
-                    flat = jax.tree.leaves(grads)
-                    inf = jnp.logical_not(
-                        jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in flat])))
-                    acc = jax.tree.map(jnp.add, acc, grads)
-                    return (acc, jnp.logical_or(inf_acc, inf), r), loss
+                    if not static_scale:
+                        flat = jax.tree.leaves(grads)
+                        inf = jnp.logical_not(jnp.all(jnp.stack(
+                            [jnp.all(jnp.isfinite(g)) for g in flat])))
+                        inf_acc = jnp.logical_or(inf_acc, inf)
+                    acc = jax.tree.map(jnp.add, acc, grads) if acc is not None \
+                        else grads
+                    return (acc, inf_acc, r), loss
 
-                zero_acc = jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
-                (acc, found_inf, _), losses = jax.lax.scan(
-                    micro, (zero_acc, jnp.asarray(False), rng), batches)
-                grads, gnorm = _unscale_and_clip(acc, scaler_state.scale, clip)
+                if gas == 1:
+                    # no accumulation buffer: saves a full-size zero init +
+                    # read-modify-write over the gradients
+                    mb = jax.tree.map(lambda x: x[0], batches)
+                    (acc, found_inf, _), loss0 = micro(
+                        (None, jnp.asarray(False), rng), mb)
+                    losses = loss0[None]
+                else:
+                    zero_acc = jax.tree.map(
+                        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                    (acc, found_inf, _), losses = jax.lax.scan(
+                        micro, (zero_acc, jnp.asarray(False), rng), batches)
+                grads, gnorm = _unscale_and_clip(
+                    acc, 1.0 if static_scale else scaler_state.scale, clip)
                 new_params, new_opt = self.optimizer.update(grads, opt_state, params,
                                                             lr=lr, step=step)
-                keep = lambda new, old: jax.tree.map(
-                    lambda n, o: jnp.where(found_inf, o, n), new, old)
-                new_params = keep(new_params, params)
-                new_opt = keep(new_opt, opt_state)
+                if not static_scale:
+                    keep = lambda new, old: jax.tree.map(
+                        lambda n, o: jnp.where(found_inf, o, n), new, old)
+                    new_params = keep(new_params, params)
+                    new_opt = keep(new_opt, opt_state)
                 new_scaler = scaler.update(scaler_state, found_inf)
                 return new_params, new_opt, new_scaler, jnp.mean(losses), gnorm
 

@@ -24,16 +24,17 @@ class FP16_UnfusedOptimizer(FP16_Optimizer):
         def step(masters, opt_state, scaler_state, grads, step_no):
             inv = 1.0 / scaler_state.scale
             grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
-            # per-leaf norms and clipping (the reference clips per group)
             found_inf = jnp.logical_not(jnp.all(jnp.stack(
                 [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)])))
+            # report the PRE-clip global norm (same contract as the fused
+            # wrapper), then clip per leaf (the reference clips per group)
+            gnorm = jnp.sqrt(sum(jnp.sum(g * g)
+                                 for g in jax.tree.leaves(grads)))
             if clip > 0:
                 grads = jax.tree.map(
                     lambda g: g * jnp.minimum(
                         1.0, clip / (jnp.linalg.norm(g.ravel()) + 1e-6)),
                     grads)
-            gnorm = jnp.sqrt(sum(jnp.sum(g * g)
-                                 for g in jax.tree.leaves(grads)))
             new_masters, new_opt = opt.update(grads, opt_state, masters,
                                               step=step_no)
             keep = lambda new, old: jax.tree.map(

@@ -1,0 +1,40 @@
+"""ZeRO-3 linear — reference ``runtime/zero/linear.py`` (the custom autograd
+``LinearFunctionForZeroStage3`` + ``LinearModuleForZeroStage3`` that keeps
+fp16 params gatherable and avoids materializing the weight grad as a second
+full tensor).
+
+Under GSPMD none of that machinery is needed — a plain Dense with sharded
+params IS the ZeRO-3 linear — so these exist for API parity and carry the
+one real knob that survives: computing in the param's dtype with fp32
+accumulation."""
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+def zero3_linear_wrap(x, weight, bias=None):
+    """Functional form (reference ``LinearFunctionForZeroStage3.apply``):
+    y = x @ W^T + b with fp32 accumulation."""
+    y = jax.lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+class LinearModuleForZeroStage3(nn.Module):
+    """Reference ``LinearModuleForZeroStage3``: a Linear whose weight layout
+    matches torch ([out, in]) so injected/converted checkpoints map 1:1."""
+    in_features: int
+    out_features: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("weight", nn.initializers.lecun_normal(),
+                       (self.out_features, self.in_features), jnp.float32)
+        b = self.param("bias", nn.initializers.zeros, (self.out_features,),
+                       jnp.float32) if self.use_bias else None
+        return zero3_linear_wrap(x, w.astype(x.dtype),
+                                 None if b is None else b)

@@ -118,14 +118,22 @@ class InferenceEngine:
             raise TypeError(f"unsupported forward arguments: {sorted(kwargs)}")
         key = "fwd" if attention_mask is None else "fwd_masked"
         if key not in self._compiled:
+            # decoder families expose a ``logits`` method; encoder modules
+            # (BERT) return logits from __call__ directly
+            has_logits = hasattr(type(self.module), "logits")
             if attention_mask is None:
-                self._compiled[key] = jax.jit(
-                    lambda p, ids: self.module.apply(
-                        p, ids, method=type(self.module).logits))
+                fwd = (lambda p, ids: self.module.apply(
+                    p, ids, method=type(self.module).logits)) if has_logits \
+                    else (lambda p, ids: self.module.apply(
+                        p, {"input_ids": ids}))
+                self._compiled[key] = jax.jit(fwd)
             else:
-                self._compiled[key] = jax.jit(
-                    lambda p, ids, m: self.module.apply(
-                        p, ids, m, method=type(self.module).logits))
+                fwd = (lambda p, ids, m: self.module.apply(
+                    p, ids, m, method=type(self.module).logits)) \
+                    if has_logits else \
+                    (lambda p, ids, m: self.module.apply(
+                        p, {"input_ids": ids, "attention_mask": m}))
+                self._compiled[key] = jax.jit(fwd)
         args = (self._params, jnp.asarray(input_ids))
         if attention_mask is not None:
             args += (jnp.asarray(attention_mask),)

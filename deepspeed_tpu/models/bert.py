@@ -1,0 +1,183 @@
+"""BERT encoder family — flax implementation over the fused encoder layer.
+
+Reference parity: the reference's inference test matrix is BERT-heavy
+(``tests/unit/inference/test_inference.py``; injection policy
+``module_inject/replace_policy.py`` HFBertLayerPolicy; the training kernels
+behind ``DeepSpeedTransformerLayer`` were built for BERT).  The encoder
+stack here IS ``DeepSpeedTransformerLayer`` (post-LN path) — the same
+layer-op users of the reference wrap, driven through a full model with
+embeddings, pooler, and the masked-LM head.
+"""
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.ops.transformer.transformer import (
+    DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: str = "float32"
+    num_labels: Optional[int] = None   # set → sequence classification head
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @property
+    def jnp_dtype(self):
+        return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+                "float16": jnp.float16}[self.dtype]
+
+    def layer_config(self):
+        return DeepSpeedTransformerConfig(
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            heads=self.num_heads,
+            layer_norm_eps=self.layer_norm_eps,
+            attn_dropout_ratio=0.0,
+            hidden_dropout_ratio=0.0,
+            pre_layer_norm=False,        # BERT is post-LN
+            fp16=self.dtype == "float16",
+            compute_dtype=self.jnp_dtype)
+
+
+class BertEmbeddings(nn.Module):
+    config: BertConfig
+
+    def setup(self):
+        cfg = self.config
+        # setup-style so the MLM head can reach word_embeddings for tying
+        self.word_embeddings = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                                        param_dtype=jnp.float32)
+        self.position_embeddings = nn.Embed(cfg.max_position_embeddings,
+                                            cfg.hidden_size,
+                                            param_dtype=jnp.float32)
+        self.token_type_embeddings = nn.Embed(cfg.type_vocab_size,
+                                              cfg.hidden_size,
+                                              param_dtype=jnp.float32)
+        self.layer_norm = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                       param_dtype=jnp.float32)
+
+    def __call__(self, input_ids, token_type_ids=None):
+        cfg = self.config
+        S = input_ids.shape[1]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(jnp.arange(S)[None])
+             + self.token_type_embeddings(token_type_ids))
+        return self.layer_norm(x).astype(cfg.jnp_dtype)
+
+
+class BertModel(nn.Module):
+    """Embeddings + N fused encoder layers + pooler (HF BertModel shape)."""
+    config: BertConfig
+    add_pooler: bool = True
+
+    def setup(self):
+        cfg = self.config
+        self.embeddings = BertEmbeddings(cfg)
+        lc = cfg.layer_config()
+        self.layers = [DeepSpeedTransformerLayer(lc, name=f"layers_{i}")
+                       for i in range(cfg.num_layers)]
+        if self.add_pooler:
+            self.pooler = nn.Dense(cfg.hidden_size, name="pooler",
+                                   param_dtype=jnp.float32,
+                                   dtype=cfg.jnp_dtype)
+
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        for layer in self.layers:
+            x = layer(x, attention_mask=attention_mask)
+        pooled = jnp.tanh(self.pooler(x[:, 0])) if self.add_pooler else None
+        return x, pooled
+
+
+class BertEncoder(nn.Module):
+    """Headless contract (HF ``BertModel``): returns the final hidden states
+    from a dict call — the module headless checkpoints convert onto."""
+    config: BertConfig
+    add_pooler: bool = False
+
+    def setup(self):
+        self.bert = BertModel(self.config, add_pooler=self.add_pooler)
+
+    def __call__(self, batch, attention_mask=None, token_type_ids=None):
+        if isinstance(batch, dict):
+            input_ids = batch["input_ids"]
+            attention_mask = batch.get("attention_mask", attention_mask)
+            token_type_ids = batch.get("token_type_ids", token_type_ids)
+        else:
+            input_ids = batch
+        h, _ = self.bert(input_ids, attention_mask, token_type_ids)
+        return h
+
+
+class BertForMaskedLM(nn.Module):
+    """HF ``BertForMaskedLM`` contract: logits over the vocab per position.
+    The decoder weight ties to the word embeddings (HF default)."""
+    config: BertConfig
+
+    def setup(self):
+        cfg = self.config
+        self.bert = BertModel(cfg, add_pooler=False)
+        self.transform_dense = nn.Dense(cfg.hidden_size, name="transform_dense",
+                                        param_dtype=jnp.float32,
+                                        dtype=cfg.jnp_dtype)
+        self.transform_ln = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                         name="transform_ln",
+                                         param_dtype=jnp.float32)
+        self.decoder_bias = self.param("decoder_bias", nn.initializers.zeros,
+                                       (cfg.vocab_size,), jnp.float32)
+
+    def __call__(self, batch, attention_mask=None, token_type_ids=None):
+        if isinstance(batch, dict):
+            input_ids = batch["input_ids"]
+            attention_mask = batch.get("attention_mask", attention_mask)
+            token_type_ids = batch.get("token_type_ids", token_type_ids)
+        else:
+            input_ids = batch
+        cfg = self.config
+        h, _ = self.bert(input_ids, attention_mask, token_type_ids)
+        h = nn.gelu(self.transform_dense(h), approximate=False)
+        h = self.transform_ln(h).astype(cfg.jnp_dtype)
+        # tied decoder: logits = h @ word_embeddings^T + bias
+        we = self.bert.embeddings.word_embeddings.embedding
+        logits = h @ we.T.astype(h.dtype) + self.decoder_bias.astype(h.dtype)
+        return logits
+
+
+class BertForSequenceClassification(nn.Module):
+    """HF ``BertForSequenceClassification`` contract (pooled CLS → labels)."""
+    config: BertConfig
+
+    def setup(self):
+        cfg = self.config
+        self.bert = BertModel(cfg, add_pooler=True)
+        self.classifier = nn.Dense(cfg.num_labels or 2, name="classifier",
+                                   param_dtype=jnp.float32,
+                                   dtype=cfg.jnp_dtype)
+
+    def __call__(self, batch, attention_mask=None, token_type_ids=None):
+        if isinstance(batch, dict):
+            input_ids = batch["input_ids"]
+            attention_mask = batch.get("attention_mask", attention_mask)
+            token_type_ids = batch.get("token_type_ids", token_type_ids)
+        else:
+            input_ids = batch
+        _, pooled = self.bert(input_ids, attention_mask, token_type_ids)
+        return self.classifier(pooled)

@@ -305,5 +305,104 @@ class GPTJPolicy(HFPolicy):
         return out
 
 
+class BertPolicy(HFPolicy):
+    """bert-* (reference ``module_inject/replace_policy.py``
+    HFBertLayerPolicy — the reference's inference test-matrix workhorse).
+    Encoder-family: converts HF BertForMaskedLM / BertModel weights onto
+    :class:`deepspeed_tpu.models.bert.BertForMaskedLM`, whose encoder stack
+    is the fused ``DeepSpeedTransformerLayer`` (post-LN)."""
+
+    model_types = ("bert",)
+
+    def build_config(self, hf, **over):
+        from deepspeed_tpu.models.bert import BertConfig
+        base = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            num_layers=hf.num_hidden_layers,
+            num_heads=hf.num_attention_heads,
+            intermediate_size=hf.intermediate_size,
+            max_position_embeddings=hf.max_position_embeddings,
+            type_vocab_size=hf.type_vocab_size,
+            layer_norm_eps=hf.layer_norm_eps,
+        )
+        # decoder-config aliases used by convert_hf_model callers
+        if "max_seq_len" in over:
+            over["max_position_embeddings"] = over.pop("max_seq_len")
+        base.update(over)
+        # unknown overrides raise (same contract as the decoder policies)
+        return BertConfig(**base)
+
+    def build_model(self, cfg):
+        from deepspeed_tpu.models.bert import (BertEncoder, BertForMaskedLM)
+        if getattr(self, "_has_mlm_head", True):
+            return BertForMaskedLM(cfg)
+        return BertEncoder(cfg, add_pooler=getattr(self, "_has_pooler", False))
+
+    def convert(self, sd, cfg):
+        H = cfg.num_heads
+        D = cfg.hidden_size // H
+        pfx = "bert." if any(k.startswith("bert.") for k in sd) else ""
+        flat = {
+            "bert/embeddings/word_embeddings/embedding":
+                _np(sd[f"{pfx}embeddings.word_embeddings.weight"]),
+            "bert/embeddings/position_embeddings/embedding":
+                _np(sd[f"{pfx}embeddings.position_embeddings.weight"]),
+            "bert/embeddings/token_type_embeddings/embedding":
+                _np(sd[f"{pfx}embeddings.token_type_embeddings.weight"]),
+            "bert/embeddings/layer_norm/scale":
+                _np(sd[f"{pfx}embeddings.LayerNorm.weight"]),
+            "bert/embeddings/layer_norm/bias":
+                _np(sd[f"{pfx}embeddings.LayerNorm.bias"]),
+        }
+        for i in range(cfg.num_layers):
+            p = f"{pfx}encoder.layer.{i}"
+            o = f"bert/layers_{i}"
+            for std, src in (("q_proj", "query"), ("k_proj", "key"),
+                             ("v_proj", "value")):
+                flat[f"{o}/{std}/kernel"] = qkv_kernel(
+                    sd[f"{p}.attention.self.{src}.weight"], H, D)
+                flat[f"{o}/{std}/bias"] = qkv_bias(
+                    sd[f"{p}.attention.self.{src}.bias"], H, D)
+            flat[f"{o}/out_proj/kernel"] = linear_kernel(
+                sd[f"{p}.attention.output.dense.weight"])
+            flat[f"{o}/out_proj/bias"] = _np(
+                sd[f"{p}.attention.output.dense.bias"])
+            flat[f"{o}/attn_ln/scale"] = _np(
+                sd[f"{p}.attention.output.LayerNorm.weight"])
+            flat[f"{o}/attn_ln/bias"] = _np(
+                sd[f"{p}.attention.output.LayerNorm.bias"])
+            flat[f"{o}/intermediate/kernel"] = linear_kernel(
+                sd[f"{p}.intermediate.dense.weight"])
+            flat[f"{o}/intermediate/bias"] = _np(
+                sd[f"{p}.intermediate.dense.bias"])
+            flat[f"{o}/output/kernel"] = linear_kernel(
+                sd[f"{p}.output.dense.weight"])
+            flat[f"{o}/output/bias"] = _np(sd[f"{p}.output.dense.bias"])
+            flat[f"{o}/mlp_ln/scale"] = _np(
+                sd[f"{p}.output.LayerNorm.weight"])
+            flat[f"{o}/mlp_ln/bias"] = _np(sd[f"{p}.output.LayerNorm.bias"])
+        # headless checkpoints (BertModel) convert onto BertEncoder; those
+        # with a pooler keep it
+        self._has_mlm_head = "cls.predictions.transform.dense.weight" in sd
+        self._has_pooler = f"{pfx}pooler.dense.weight" in sd
+        if self._has_pooler and not self._has_mlm_head:
+            flat["bert/pooler/kernel"] = linear_kernel(
+                sd[f"{pfx}pooler.dense.weight"])
+            flat["bert/pooler/bias"] = _np(sd[f"{pfx}pooler.dense.bias"])
+        # MLM head (present on BertForMaskedLM checkpoints)
+        if "cls.predictions.transform.dense.weight" in sd:
+            flat["transform_dense/kernel"] = linear_kernel(
+                sd["cls.predictions.transform.dense.weight"])
+            flat["transform_dense/bias"] = _np(
+                sd["cls.predictions.transform.dense.bias"])
+            flat["transform_ln/scale"] = _np(
+                sd["cls.predictions.transform.LayerNorm.weight"])
+            flat["transform_ln/bias"] = _np(
+                sd["cls.predictions.transform.LayerNorm.bias"])
+            flat["decoder_bias"] = _np(sd["cls.predictions.bias"])
+        return flat
+
+
 ALL_POLICIES = [OPTPolicy, GPT2Policy, LlamaPolicy, BloomPolicy,
-                GPTNeoXPolicy, GPTJPolicy]
+                GPTNeoXPolicy, GPTJPolicy, BertPolicy]

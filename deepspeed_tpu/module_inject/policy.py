@@ -104,6 +104,12 @@ class HFPolicy:
     def build_config(self, hf_config, **overrides) -> TransformerConfig:
         raise NotImplementedError
 
+    def build_model(self, cfg):
+        """The flax module the converted weights load into (decoder families
+        share ``Transformer``; encoder policies override)."""
+        from deepspeed_tpu.models.transformer import Transformer
+        return Transformer(cfg)
+
     # -- weights --------------------------------------------------------- #
     def layer_params(self, sd, i, cfg) -> dict:
         """{relative-path: array} for layer i (keys like

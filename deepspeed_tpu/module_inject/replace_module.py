@@ -20,7 +20,6 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models.transformer import Transformer
 from deepspeed_tpu.module_inject.containers import ALL_POLICIES
 from deepspeed_tpu.runtime.zero.partition import path_to_str
 from deepspeed_tpu.utils.logging import logger
@@ -91,7 +90,7 @@ def convert_hf_model(model_or_name, param_dtype=None, **config_overrides):
     logger.info(f"converted {hf_config.model_type} model: "
                 f"{len(consumed_hint)} HF tensors → {len(flat)} flax tensors, "
                 f"{cfg.num_layers}L/{cfg.hidden_size}H")
-    model = Transformer(cfg)
+    model = policy.build_model(cfg)
     params = _materialize(model, flat, param_dtype=param_dtype)
     return model, params
 

@@ -45,6 +45,8 @@ class DeepSpeedTransformerConfig:
     stochastic_mode: bool = False
     return_tuple: bool = False
     training: bool = True
+    # explicit compute dtype; None keeps the reference's fp16-flag semantics
+    compute_dtype: Optional[object] = None
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -52,6 +54,8 @@ class DeepSpeedTransformerConfig:
 
     @property
     def dtype(self):
+        if self.compute_dtype is not None:
+            return self.compute_dtype
         return jnp.float16 if self.fp16 else jnp.float32
 
 
@@ -110,7 +114,7 @@ class DeepSpeedTransformerLayer(nn.Module):
 
         def mlp(y):
             z = dense(cfg.intermediate_size, "intermediate")(y)
-            z = nn.gelu(z)
+            z = nn.gelu(z, approximate=False)  # BERT-exact erf gelu
             z = dense(h, "output")(z)
             if cfg.hidden_dropout_ratio > 0 and not deterministic:
                 z = nn.Dropout(cfg.hidden_dropout_ratio)(

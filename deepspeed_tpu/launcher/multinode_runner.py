@@ -60,42 +60,60 @@ class PDSHRunner(MultiNodeRunner):
         env_flags = [f"export {k}={v};" for k, v in self.exports.items()]
         # %n is pdsh's per-host rank — becomes the jax process id
         env_flags.append("export DSTPU_PROCESS_ID=%n;")
-        remote = " ".join(env_flags + [sys.executable, "-u", self.user_script]
+        remote = " ".join([f"cd {os.getcwd()};"] + env_flags
+                          + [sys.executable, "-u", self.user_script]
                           + self.user_arguments)
         return ["pdsh", "-S", "-f", "1024", "-w", hosts, remote]
+
+
+def _rank_wrapped_tail(user_script, user_arguments, rank_var):
+    """Per-host shell that maps the backend's rank var to the jax process
+    id and restores the launch cwd before exec'ing the user script."""
+    tail = " ".join([sys.executable, "-u", user_script] + list(user_arguments))
+    return ["bash", "-c",
+            f"cd {os.getcwd()} && "
+            f"DSTPU_PROCESS_ID=${{{rank_var}}} exec {tail}"]
 
 
 class OpenMPIRunner(MultiNodeRunner):
     """Reference ``:107``: mpirun with one proc per host and -x env exports."""
 
+    rank_var = "OMPI_COMM_WORLD_RANK"
+
     def backend_exists(self):
         return shutil.which("mpirun") is not None
 
     def get_cmd(self, environment, active_resources):
         self.validate_args()
         total = len(active_resources)
+        # --host takes the FILTERED pool (not the raw hostfile, which may
+        # contain --exclude'd hosts)
         cmd = ["mpirun", "-n", str(total), "--map-by", "ppr:1:node",
-               "-hostfile", getattr(self.args, "hostfile", "hostfile"),
+               "--host", ",".join(active_resources.keys()),
                "--mca", "btl", "^openib"]
         for k, v in self.exports.items():
             cmd += ["-x", f"{k}={v}"]
-        # OMPI_COMM_WORLD_RANK is read by the bootstrap as the process id
-        return cmd + [sys.executable, "-u", self.user_script] + self.user_arguments
+        return cmd + _rank_wrapped_tail(self.user_script, self.user_arguments,
+                                        self.rank_var)
 
 
 class MPICHRunner(MultiNodeRunner):
     """Reference ``:160``."""
 
+    rank_var = "PMI_RANK"
+
     def backend_exists(self):
         return shutil.which("mpirun") is not None
 
     def get_cmd(self, environment, active_resources):
         self.validate_args()
         total = len(active_resources)
-        cmd = ["mpirun", "-n", str(total), "-ppn", "1"]
+        cmd = ["mpirun", "-n", str(total), "-ppn", "1",
+               "-hosts", ",".join(active_resources.keys())]
         for k, v in self.exports.items():
             cmd += ["-genv", k, v]
-        return cmd + [sys.executable, "-u", self.user_script] + self.user_arguments
+        return cmd + _rank_wrapped_tail(self.user_script, self.user_arguments,
+                                        self.rank_var)
 
 
 class SlurmRunner(MultiNodeRunner):
@@ -113,8 +131,8 @@ class SlurmRunner(MultiNodeRunner):
             cmd.append(f"--export=ALL,{exports}")
         if getattr(self.args, "comment", ""):
             cmd += ["--comment", self.args.comment]
-        # SLURM_PROCID becomes the jax process id
-        return cmd + [sys.executable, "-u", self.user_script] + self.user_arguments
+        return cmd + _rank_wrapped_tail(self.user_script, self.user_arguments,
+                                        "SLURM_PROCID")
 
 
 class MVAPICHRunner(MPICHRunner):

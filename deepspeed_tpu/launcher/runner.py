@@ -44,7 +44,8 @@ def parse_args(args=None):
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--launcher", type=str, default="ssh",
-                        choices=["ssh", "pdsh", "local"])
+                        choices=["ssh", "local", "pdsh", "openmpi", "mpich",
+                                 "slurm", "mvapich"])
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("--elastic_training", action="store_true")
     parser.add_argument("--min_elastic_nodes", type=int, default=-1)
@@ -117,6 +118,26 @@ def main(args=None):
     hosts = list(resource_pool)
     master = args.master_addr or hosts[0]
     world = len(hosts)
+
+    if args.launcher not in ("ssh",):
+        # PDSH/MPI/SLURM fan-out through the MultiNodeRunner command builders
+        # (reference multinode_runner.py); one process per host, coordinator
+        # env exported everywhere, per-host rank from the backend's own
+        # rank mechanism
+        from deepspeed_tpu.launcher.multinode_runner import build_runner
+        world_info = encode_world_info(resource_pool)
+        runner = build_runner(args.launcher, args, world_info)
+        runner.add_export("DSTPU_COORDINATOR_ADDRESS",
+                          f"{master}:{args.master_port}")
+        runner.add_export("DSTPU_NUM_PROCESSES", str(world))
+        # per-host slot counts for the bootstrapped processes (the analog of
+        # the reference's --world_info)
+        runner.add_export("DSTPU_WORLD_INFO", world_info)
+        cmd = runner.get_cmd(dict(os.environ), resource_pool)
+        logger.info(f"launching via {runner.name}: {' '.join(cmd)}")
+        result = subprocess.run(cmd, env=dict(os.environ))
+        sys.exit(result.returncode)
+
     procs = []
     logger.info(f"launching on {world} hosts via {args.launcher}: {hosts}")
     for pid, host in enumerate(hosts):

@@ -5,6 +5,7 @@ import argparse
 import sys
 
 import numpy as np
+import pytest
 
 from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
     MMapIndexedDataset, MMapIndexedDatasetBuilder, make_dataset)
@@ -81,7 +82,10 @@ def test_multinode_runner_cmds():
     mpi.add_export("A", "b")
     cmd = mpi.get_cmd({}, resources)
     assert cmd[:3] == ["mpirun", "-n", "2"] and "-x" in cmd and "A=b" in cmd
-    assert "train.py" in cmd and cmd[-2:] == ["--x", "1"]
+    # filtered hosts (not the raw hostfile) + rank-var → process-id wrapper
+    assert "host1,host2" in cmd
+    assert "train.py --x 1" in cmd[-1]
+    assert "DSTPU_PROCESS_ID=${OMPI_COMM_WORLD_RANK}" in cmd[-1]
 
     slurm = SlurmRunner(_args())
     slurm.add_export("E", "f")
@@ -95,7 +99,34 @@ def test_multinode_runner_cmds():
     assert "-genv" in cmd and "-ppn" in cmd
 
 
+def test_runner_main_dispatches_multinode(tmp_path, monkeypatch):
+    """deepspeed CLI with --launcher slurm must hand off to the
+    MultiNodeRunner-built command with the coordinator env exported."""
+    from deepspeed_tpu.launcher import runner as runner_mod
+    hf = tmp_path / "hostfile"
+    hf.write_text("host1 slots=4\nhost2 slots=4\n")
+    captured = {}
+
+    class FakeResult:
+        returncode = 0
+
+    def fake_run(cmd, env=None):
+        captured["cmd"] = cmd
+        return FakeResult()
+
+    monkeypatch.setattr(runner_mod.subprocess, "run", fake_run)
+    with pytest.raises(SystemExit) as e:
+        runner_mod.main(["-H", str(hf), "--launcher", "slurm",
+                         "train.py", "--lr", "1"])
+    assert e.value.code == 0
+    cmd = captured["cmd"]
+    assert cmd[0] == "srun" and "-N" in cmd and "2" in cmd
+    assert any("DSTPU_COORDINATOR_ADDRESS=host1:" in c for c in cmd)
+    assert any("DSTPU_WORLD_INFO=" in c for c in cmd)
+    assert "train.py" in cmd[-1]
+    assert "DSTPU_PROCESS_ID=${SLURM_PROCID}" in cmd[-1]
+
+
 def test_build_runner_unknown():
-    import pytest
     with pytest.raises(ValueError):
         build_runner("bogus", _args())

@@ -57,21 +57,26 @@ class PDSHRunner(MultiNodeRunner):
     def get_cmd(self, environment, active_resources):
         self.validate_args()
         hosts = ",".join(active_resources.keys())
-        env_flags = [f"export {k}={v};" for k, v in self.exports.items()]
+        import shlex
+        env_flags = [f"export {k}={shlex.quote(v)};"
+                     for k, v in self.exports.items()]
         # %n is pdsh's per-host rank — becomes the jax process id
         env_flags.append("export DSTPU_PROCESS_ID=%n;")
-        remote = " ".join([f"cd {os.getcwd()};"] + env_flags
-                          + [sys.executable, "-u", self.user_script]
-                          + self.user_arguments)
+        remote = " ".join(
+            [f"cd {shlex.quote(os.getcwd())};"] + env_flags
+            + [shlex.quote(c) for c in
+               [sys.executable, "-u", self.user_script] + self.user_arguments])
         return ["pdsh", "-S", "-f", "1024", "-w", hosts, remote]
 
 
 def _rank_wrapped_tail(user_script, user_arguments, rank_var):
     """Per-host shell that maps the backend's rank var to the jax process
     id and restores the launch cwd before exec'ing the user script."""
-    tail = " ".join([sys.executable, "-u", user_script] + list(user_arguments))
+    import shlex
+    tail = " ".join(shlex.quote(c) for c in
+                    [sys.executable, "-u", user_script] + list(user_arguments))
     return ["bash", "-c",
-            f"cd {os.getcwd()} && "
+            f"cd {shlex.quote(os.getcwd())} && "
             f"DSTPU_PROCESS_ID=${{{rank_var}}} exec {tail}"]
 
 
@@ -126,7 +131,10 @@ class SlurmRunner(MultiNodeRunner):
         self.validate_args()
         total = len(active_resources)
         exports = ",".join(f"{k}={v}" for k, v in self.exports.items())
-        cmd = ["srun", "-N", str(total), "--ntasks-per-node=1"]
+        # -w pins the FILTERED host pool (parity with the --host fix for
+        # the MPI runners; --exclude'd nodes must not receive ranks)
+        cmd = ["srun", "-N", str(total), "--ntasks-per-node=1",
+               "-w", ",".join(active_resources.keys())]
         if exports:
             cmd.append(f"--export=ALL,{exports}")
         if getattr(self.args, "comment", ""):

@@ -262,6 +262,17 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None):
     """
     B, S, H, D = q.shape
     KVH, S_max = k_cache.shape[2], k_cache.shape[1]
+    if S == 1 and bias is None:
+        # single-token decode: the Pallas online-softmax kernel streams the
+        # cache blockwise instead of materializing [B,H,1,S_max] fp32 logits
+        from deepspeed_tpu.ops.transformer.decode_attention import (
+            decode_attention)
+        from deepspeed_tpu.ops.transformer.flash_attention import (
+            pallas_supported)
+        if pallas_supported():
+            lengths = (q_positions[:, 0] + 1).astype(jnp.int32)
+            return decode_attention(q[:, 0], k_cache, v_cache,
+                                    lengths)[:, None]
     if KVH != H:
         rep = H // KVH
         k_cache = jnp.repeat(k_cache, rep, axis=2)

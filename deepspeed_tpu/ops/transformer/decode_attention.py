@@ -1,0 +1,119 @@
+"""Pallas decode attention — the KV-cache generation kernel.
+
+TPU-native equivalent of the reference's ``softmax_context`` inference op
+(``csrc/transformer/inference/csrc/pt_binding.cpp:1934-``; the attention
+half of its decode pipeline).  Single-token decode: one query row per
+(batch, head) attends over the cache.
+
+Kernel layout: the HEAD dim rides the sublanes — per (batch, kv-head) grid
+cell the query block is [G, D] (G = query heads per kv head; MHA → G per
+block of heads), so the QK^T matmul is [G, D] × [D, bk] on the MXU instead
+of a degenerate [1, D] row.  The KV length mask (cache tail + causality for
+a single new token collapse to ``pos < length``) is applied per block, and
+an online softmax accumulates across KV blocks so the cache never
+materializes an S_max-wide probability row in fp32 HBM.
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deepspeed_tpu.ops.transformer.flash_attention import (LSE_LANES, NEG_INF,
+                                                           _interpret)
+
+DEFAULT_BLOCK_K_DECODE = 512
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                   *, scale, block_k, nk):
+    b = pl.program_id(0)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[b]
+    # skip KV blocks entirely past the live cache region
+    @pl.when(ik * block_k < length)
+    def _body():
+        q = q_ref[0, 0]                                  # [G, D]
+        k = k_ref[0, 0]                                  # [bk, D]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)                  # [1, bk]
+        s = jnp.where(pos < length, s, NEG_INF)          # cache tail mask
+        m_prev = m_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(pos < length, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, 0:1] * corr + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = l_scr[:, 0:1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+def decode_attention(q, k_cache, v_cache, lengths,
+                     scale=None, block_k=DEFAULT_BLOCK_K_DECODE):
+    """Single-token decode attention.
+
+    q: [B, H, D] (this step's query); caches: [B, S_max, KVH, D];
+    lengths: [B] int32 — number of valid cache entries INCLUDING this
+    step's freshly-written position.  Returns [B, H, D].
+    """
+    B, H, D = q.shape
+    S_max, KVH = k_cache.shape[1], k_cache.shape[2]
+    G = H // KVH                                         # query heads per kv head
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(D))
+    block_k = min(block_k, S_max)
+    nk = pl.cdiv(S_max, block_k)
+    qg = q.reshape(B, KVH, G, D)
+    kt = k_cache.transpose(0, 2, 1, 3)                   # [B, KVH, S, D]
+    vt = v_cache.transpose(0, 2, 1, 3)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=float(scale),
+                          block_k=block_k, nk=nk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, KVH, nk),
+            in_specs=[
+                pl.BlockSpec((1, 1, G, D), lambda b, h, ik, lens: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_k, D),
+                             lambda b, h, ik, lens: (b, h, ik, 0)),
+                pl.BlockSpec((1, 1, block_k, D),
+                             lambda b, h, ik, lens: (b, h, ik, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, G, D),
+                                   lambda b, h, ik, lens: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((G, LSE_LANES), jnp.float32),
+                pltpu.VMEM((G, LSE_LANES), jnp.float32),
+                pltpu.VMEM((G, D), jnp.float32),
+            ]),
+        out_shape=jax.ShapeDtypeStruct((B, KVH, G, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(jnp.asarray(lengths, jnp.int32), qg, kt, vt)
+    return out.reshape(B, H, D)

@@ -792,6 +792,13 @@ class DeepSpeedEngine:
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self.tput_timer.stop(global_step=True)
+        if self.monitor.enabled and self.global_steps % self.steps_per_print() == 0:
+            # same Train/Samples series the 3-call path emits — fetching the
+            # loss here syncs, but only every steps_per_print steps
+            self.monitor.write_events(
+                [("Train/Samples/lr", self.get_lr()[0], self.global_samples),
+                 ("Train/Samples/train_loss", float(jax.device_get(loss)),
+                  self.global_samples)])
         return loss
 
     def eval_batch(self, batch):

@@ -49,14 +49,6 @@ class XlaBackend(Backend):
         coordinator = os.environ.get("DSTPU_COORDINATOR_ADDRESS")
         num_processes = os.environ.get("DSTPU_NUM_PROCESSES")
         process_id = os.environ.get("DSTPU_PROCESS_ID")
-        if num_processes is None and os.environ.get("DSTPU_WORLD_INFO"):
-            # launcher-exported world info (b64 {host: slots}) — one
-            # controller process per host
-            import base64
-            import json
-            info = json.loads(base64.urlsafe_b64decode(
-                os.environ["DSTPU_WORLD_INFO"].encode()).decode())
-            num_processes = str(len(info))
         if coordinator is not None:
             jax.distributed.initialize(
                 coordinator_address=coordinator,

@@ -12,7 +12,7 @@ kernels (``_apply_injection_policy :408``), slices weights for TP
 * TP weight slicing is a sharding plan (AutoTP name rules,
   ``runtime/zero/partition.py``) applied as param ``NamedSharding``s — XLA
   inserts the per-layer collectives the reference codes by hand;
-* the KV cache is a donated, statically-shaped [L, B, S_max, KVH, D] buffer
+* the KV cache is a donated, statically-shaped [L, B, KVH, S_max, D] buffer
   updated in-place via donation (the workspace allocator equivalent);
 * CUDA-graph capture/replay == jit compile/execute — every step after the
   first runs from the executable cache.

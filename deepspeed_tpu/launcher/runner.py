@@ -125,14 +125,11 @@ def main(args=None):
         # env exported everywhere, per-host rank from the backend's own
         # rank mechanism
         from deepspeed_tpu.launcher.multinode_runner import build_runner
-        world_info = encode_world_info(resource_pool)
-        runner = build_runner(args.launcher, args, world_info)
+        runner = build_runner(args.launcher, args,
+                              encode_world_info(resource_pool))
         runner.add_export("DSTPU_COORDINATOR_ADDRESS",
                           f"{master}:{args.master_port}")
         runner.add_export("DSTPU_NUM_PROCESSES", str(world))
-        # per-host slot counts for the bootstrapped processes (the analog of
-        # the reference's --world_info)
-        runner.add_export("DSTPU_WORLD_INFO", world_info)
         cmd = runner.get_cmd(dict(os.environ), resource_pool)
         logger.info(f"launching via {runner.name}: {' '.join(cmd)}")
         result = subprocess.run(cmd, env=dict(os.environ))

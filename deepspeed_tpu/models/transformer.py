@@ -254,14 +254,14 @@ def _attention(q, k, v, config, mask=None, bias=None):
 def cached_attention(q, k_cache, v_cache, q_positions, bias=None):
     """Decode attention against a KV cache.
 
-    q: [B, S, H, D]; caches: [B, S_max, KVH, D]; q_positions: [B, S]
-    absolute positions.  KV entries at positions > q_pos are masked — this
-    covers both causality and the unwritten cache tail.  TPU-native analog of
-    the reference ``softmax_context`` KV-cache op
+    q: [B, S, H, D]; caches: [B, KVH, S_max, D] (head-major); q_positions:
+    [B, S] absolute positions.  KV entries at positions > q_pos are masked —
+    this covers both causality and the unwritten cache tail.  TPU-native
+    analog of the reference ``softmax_context`` KV-cache op
     (``csrc/transformer/inference/csrc/pt_binding.cpp``).
     """
     B, S, H, D = q.shape
-    KVH, S_max = k_cache.shape[2], k_cache.shape[1]
+    KVH, S_max = k_cache.shape[1], k_cache.shape[2]
     if S == 1 and bias is None:
         # single-token decode: the Pallas online-softmax kernel streams the
         # cache blockwise instead of materializing [B,H,1,S_max] fp32 logits
@@ -275,17 +275,17 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None):
                                     lengths)[:, None]
     if KVH != H:
         rep = H // KVH
-        k_cache = jnp.repeat(k_cache, rep, axis=2)
-        v_cache = jnp.repeat(v_cache, rep, axis=2)
+        k_cache = jnp.repeat(k_cache, rep, axis=1)
+        v_cache = jnp.repeat(v_cache, rep, axis=1)
     scale = 1.0 / np.sqrt(D)
-    logits = jnp.einsum("bshd,bthd->bhst", q, k_cache).astype(jnp.float32) * scale
+    logits = jnp.einsum("bshd,bhtd->bhst", q, k_cache).astype(jnp.float32) * scale
     if bias is not None:
         logits = logits + bias[None, :, None, :].astype(jnp.float32)
     kv_pos = jnp.arange(S_max)
     ok = q_positions[:, None, :, None] >= kv_pos[None, None, None, :]
     logits = jnp.where(ok, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhst,bthd->bshd", probs, v_cache)
+    return jnp.einsum("bhst,bhtd->bshd", probs, v_cache)
 
 
 class Attention(nn.Module):
@@ -304,7 +304,7 @@ class Attention(nn.Module):
             q, k = _rope(q, k, positions, D, cfg.rope_theta,
                          rope_dim=cfg.rope_dim,
                          interleaved=cfg.rope_interleaved)
-        bias = alibi_bias(H, cache["k"].shape[1] if cache is not None
+        bias = alibi_bias(H, cache["k"].shape[2] if cache is not None
                           else x.shape[1]) \
             if cfg.position_embedding == "alibi" else None
         if cache is not None:
@@ -316,12 +316,17 @@ class Attention(nn.Module):
                 logger.warning(
                     "sparse_attention model decoding with dense KV-cache "
                     "attention — train/decode attention patterns differ")
-            # write this step's k/v at the current position, attend over cache
+            # write this step's k/v at the current position, attend over
+            # cache; cache layout is [B, KVH, S_max, D] (head-major so the
+            # decode kernel blocks the seq dim with NO relayout of the
+            # full cache — only the new S_step tokens transpose)
             start = positions[0, 0]
             k_cache = jax.lax.dynamic_update_slice(
-                cache["k"], k.astype(cache["k"].dtype), (0, start, 0, 0))
+                cache["k"], k.transpose(0, 2, 1, 3).astype(cache["k"].dtype),
+                (0, 0, start, 0))
             v_cache = jax.lax.dynamic_update_slice(
-                cache["v"], v.astype(cache["v"].dtype), (0, start, 0, 0))
+                cache["v"], v.transpose(0, 2, 1, 3).astype(cache["v"].dtype),
+                (0, 0, start, 0))
             out = cached_attention(q, k_cache, v_cache, positions, bias=bias)
             new_cache = {"k": k_cache, "v": v_cache}
         else:
@@ -458,11 +463,13 @@ class Transformer(nn.Module):
         return self._head(h), new_cache
 
     def init_cache(self, batch_size, max_len, dtype=None):
-        """Zero KV cache: [L, B, max_len, KVH, D] per k/v (layer-stacked for
-        the scanned trunk)."""
+        """Zero KV cache: [L, B, KVH, max_len, D] per k/v (layer-stacked for
+        the scanned trunk; head-major so decode blocks the seq dim without
+        relayout)."""
         cfg = self.config
         dtype = dtype or cfg.jnp_dtype
-        shape = (cfg.num_layers, batch_size, max_len, cfg.kv_heads, cfg.head_dim)
+        shape = (cfg.num_layers, batch_size, cfg.kv_heads, max_len,
+                 cfg.head_dim)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
     def __call__(self, batch):

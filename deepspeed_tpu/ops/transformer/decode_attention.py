@@ -76,20 +76,22 @@ def decode_attention(q, k_cache, v_cache, lengths,
                      scale=None, block_k=DEFAULT_BLOCK_K_DECODE):
     """Single-token decode attention.
 
-    q: [B, H, D] (this step's query); caches: [B, S_max, KVH, D];
-    lengths: [B] int32 — number of valid cache entries INCLUDING this
-    step's freshly-written position.  Returns [B, H, D].
+    q: [B, H, D] (this step's query); caches: [B, KVH, S_max, D]
+    (head-major — the model stores them this way so NO cache relayout
+    happens per decode step); lengths: [B] int32 — number of valid cache
+    entries INCLUDING this step's freshly-written position.
+    Returns [B, H, D].
     """
     B, H, D = q.shape
-    S_max, KVH = k_cache.shape[1], k_cache.shape[2]
+    KVH, S_max = k_cache.shape[1], k_cache.shape[2]
     G = H // KVH                                         # query heads per kv head
     if scale is None:
         scale = 1.0 / float(np.sqrt(D))
     block_k = min(block_k, S_max)
     nk = pl.cdiv(S_max, block_k)
     qg = q.reshape(B, KVH, G, D)
-    kt = k_cache.transpose(0, 2, 1, 3)                   # [B, KVH, S, D]
-    vt = v_cache.transpose(0, 2, 1, 3)
+    kt = k_cache
+    vt = v_cache
 
     out = pl.pallas_call(
         functools.partial(_decode_kernel, scale=float(scale),

@@ -767,9 +767,10 @@ class DeepSpeedEngine:
                 loss = self.forward(mb)
                 self.backward(loss)
                 micro_losses.append(loss)
-            self.step()
-            # match the fused path's metric: mean over the global batch
+            # mean over the global batch, assigned BEFORE step() so the
+            # monitor event written inside step() logs THIS iteration's loss
             self._last_loss = jnp.mean(jnp.stack(micro_losses))
+            self.step()
             return self._last_loss
         self._lazy_init((jax.tree.map(lambda x: x[0], batch),), {})
         batch = self._curriculum_slice(batch, 2)

@@ -29,10 +29,11 @@ def test_decode_matches_cached_attention(kvh, length):
     B, H, D, S_max = 2, 8, 16, 64
     rng = np.random.default_rng(length * 10 + kvh)
     q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
-    k = jnp.zeros((B, S_max, kvh, D), jnp.float32)
-    v = jnp.zeros((B, S_max, kvh, D), jnp.float32)
-    k = k.at[:, :length].set(rng.standard_normal((B, length, kvh, D)))
-    v = v.at[:, :length].set(rng.standard_normal((B, length, kvh, D)))
+    # caches are head-major [B, KVH, S_max, D]
+    k = jnp.zeros((B, kvh, S_max, D), jnp.float32)
+    v = jnp.zeros((B, kvh, S_max, D), jnp.float32)
+    k = k.at[:, :, :length].set(rng.standard_normal((B, kvh, length, D)))
+    v = v.at[:, :, :length].set(rng.standard_normal((B, kvh, length, D)))
     pos = jnp.full((B, 1), length - 1, jnp.int32)
     want = np.asarray(xla_cached_attention(q, k, v, pos))          # [B,1,H,D]
     got = np.asarray(decode_attention(
@@ -45,8 +46,8 @@ def test_decode_per_batch_lengths():
     B, H, D, S_max = 3, 4, 8, 32
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
-    k = jnp.asarray(rng.standard_normal((B, S_max, H, D)), jnp.float32)
-    v = jnp.asarray(rng.standard_normal((B, S_max, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, S_max, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, S_max, D)), jnp.float32)
     lengths = jnp.asarray([1, 16, 32], jnp.int32)
     got = np.asarray(decode_attention(q, k, v, lengths))
     for b, L in enumerate([1, 16, 32]):
@@ -61,8 +62,8 @@ def test_decode_blocked_cache():
     B, H, D, S_max = 1, 8, 16, 2048
     rng = np.random.default_rng(1)
     q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
-    k = jnp.asarray(rng.standard_normal((B, S_max, H, D)), jnp.float32)
-    v = jnp.asarray(rng.standard_normal((B, S_max, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, S_max, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, S_max, D)), jnp.float32)
     L = 1500
     got = np.asarray(decode_attention(q, k, v,
                                       jnp.asarray([L], jnp.int32),

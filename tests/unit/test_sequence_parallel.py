@@ -92,6 +92,19 @@ def test_model_trains_with_sequence_parallel(impl):
     assert losses[-1] < losses[0], losses
 
 
+def test_ring_attention_long_context(eight_devices):
+    """Long-context: seq 4096 over 8 sp shards — each device only ever holds
+    a 512-token KV block; numerics still match dense attention."""
+    mesh = Mesh(np.asarray(eight_devices), ("sp",))
+    q, k, v = _qkv(B=1, S=4096, H=2, D=8, seed=7)
+    fn = shard_map_attention(mesh, impl="ring", causal=True)
+    sharded = NamedSharding(mesh, P(None, "sp"))
+    qs, ks, vs = (jax.device_put(x, sharded) for x in (q, k, v))
+    got = np.asarray(jax.jit(fn)(qs, ks, vs))
+    want = np.asarray(reference_attention(q, k, v, causal=True))
+    np.testing.assert_allclose(got, want, rtol=3e-3, atol=3e-3)
+
+
 def test_sequence_parallel_unknown_impl():
     from deepspeed_tpu.parallel.sequence import sequence_parallel_attention
     with pytest.raises(ValueError):

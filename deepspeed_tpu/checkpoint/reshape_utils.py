@@ -62,9 +62,12 @@ def reshape_tp(shards, target_degree, dim):
 def infer_tp_dim(param_name, ndim, rules=None):
     """Which dim a parameter splits on for TP, or None if replicated.
 
-    Dims are for the framework's native flax layouts: column-parallel →
-    last dim, row-parallel → second-to-last (covers both 2-D ``Dense`` and
-    3-D ``DenseGeneral`` kernels), embeddings → vocab dim 0.
+    MUST agree with the runtime placement (``tp_spec_for`` in
+    ``runtime/zero/partition.py``) — checkpoint surgery along any other axis
+    silently corrupts resharded weights: column-parallel → output dim (last
+    dim of a 2-D ``Dense`` kernel; the HEAD dim, ndim-2, of a ≥3-D
+    ``DenseGeneral`` kernel), row-parallel → first (input) dim,
+    embeddings → vocab dim 0.
     """
     if ndim < 2:
         return None
@@ -73,7 +76,8 @@ def infer_tp_dim(param_name, ndim, rules=None):
     low = param_name.lower()
     for pattern, kind in rules:
         if re.search(pattern, low):
-            dim = {"col": ndim - 1, "row": ndim - 2, "vocab": 0}.get(kind)
+            col_dim = ndim - 1 if ndim == 2 else ndim - 2
+            dim = {"col": col_dim, "row": 0, "vocab": 0}.get(kind)
             return dim if dim is not None and dim >= 0 else None
     return None
 

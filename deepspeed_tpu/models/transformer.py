@@ -57,6 +57,7 @@ class TransformerConfig:
     tie_word_embeddings: bool = False
     dtype: str = "bfloat16"
     use_flash_attention: bool = True
+    fused_qkv: bool = False                  # single fused QKV gemm (MHA only)
     sparse_attention: Optional[object] = None  # SparsityConfig → block-sparse
     # "ulysses" | "ring" routes training attention through explicit
     # sequence-parallel collectives over the live sp mesh axis; None leaves
@@ -297,9 +298,21 @@ class Attention(nn.Module):
         D, H, KVH = cfg.head_dim, cfg.num_heads, cfg.kv_heads
         dense = partial(nn.DenseGeneral, use_bias=cfg.attn_bias_enabled,
                         dtype=cfg.jnp_dtype, param_dtype=jnp.float32)
-        q = dense(features=(H, D), name="q_proj")(x)
-        k = dense(features=(KVH, D), name="k_proj")(x)
-        v = dense(features=(KVH, D), name="v_proj")(x)
+        if cfg.fused_qkv and KVH != H:
+            logger.warning(
+                "fused_qkv requested but num_kv_heads != num_heads (GQA) — "
+                "falling back to separate q/k/v projections; the param tree "
+                "will carry q_proj/k_proj/v_proj, not qkv_proj")
+        if cfg.fused_qkv and KVH == H:
+            # one [h, 3·H·D] gemm instead of three [h, H·D] gemms — better
+            # MXU utilization at small hidden sizes (checkpoint conversion
+            # policies emit separate projections, so this is opt-in)
+            qkv = dense(features=(3, H, D), name="qkv_proj")(x)
+            q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        else:
+            q = dense(features=(H, D), name="q_proj")(x)
+            k = dense(features=(KVH, D), name="k_proj")(x)
+            v = dense(features=(KVH, D), name="v_proj")(x)
         if cfg.position_embedding == "rope":
             q, k = _rope(q, k, positions, D, cfg.rope_theta,
                          rope_dim=cfg.rope_dim,

@@ -93,8 +93,9 @@ def apply_zero_to_spec(shape, spec, mesh, zero_axes):
 # structure; here we infer from param-tree path names).
 # --------------------------------------------------------------------- #
 # (regex over joined path, partition spec entries by dim-from-the-right)
-# "col" = shard output features (last dim of a kernel), "row" = shard input
-# features (first dim of a 2D kernel) — Megatron column/row linear.
+# "col" = shard output features: the last dim of a 2-D kernel, the HEAD dim
+# (ndim-2) of a ≥3-D DenseGeneral kernel (whole heads per tp rank).
+# "row" = shard input features (dim 0) — Megatron column/row linear.
 # Expert-parameter contract: a path component named ``experts`` or a leaf
 # named ``experts_*`` marks a STACKED expert parameter whose dim 0 is the
 # expert dim (the layout ``moe/layer.py ExpertsMLP`` produces).  Custom
@@ -138,7 +139,12 @@ def tp_spec_for(path_str, shape, mesh, rules=None):
     for pattern, kind in rules:
         if re.search(pattern, low):
             spec = [None] * ndim
-            dim = {"col": ndim - 1, "row": ndim - 2, "vocab": 0}.get(kind)
+            # column-parallel: shard the output dim — for DenseGeneral
+            # kernels [in, ..., H, D] that's the HEAD dim (ndim-2), so whole
+            # heads land per tp rank (Megatron layout), not split head_dims.
+            # row-parallel: shard the (first) input dim.
+            col_dim = ndim - 1 if ndim == 2 else ndim - 2
+            dim = {"col": col_dim, "row": 0, "vocab": 0}.get(kind)
             if dim is not None and dim >= 0 and shape[dim] % tp_size == 0:
                 spec[dim] = TP_AXIS
             # "replicate" (or non-divisible) leaves all None

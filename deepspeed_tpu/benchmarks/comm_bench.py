@@ -54,6 +54,13 @@ def bench_collective(op_name, sizes_mb, iters=10):
 
 
 def main():
+    import os
+    # honor a JAX_PLATFORMS override: the environment may pin the platform
+    # at interpreter start (sitecustomize), so the env var alone is not
+    # enough — update the live config before the backend initializes
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     parser = argparse.ArgumentParser()
     parser.add_argument("--op", default="all_reduce",
                         choices=["all_reduce", "all_gather", "reduce_scatter"])

@@ -71,15 +71,15 @@ def infer_tp_dim(param_name, ndim, rules=None):
     """
     if ndim < 2:
         return None
-    from deepspeed_tpu.runtime.zero.partition import DEFAULT_TP_RULES
-    rules = rules if rules is not None else DEFAULT_TP_RULES
-    low = param_name.lower()
-    for pattern, kind in rules:
-        if re.search(pattern, low):
-            col_dim = ndim - 1 if ndim == 2 else ndim - 2
-            dim = {"col": col_dim, "row": 0, "vocab": 0}.get(kind)
-            return dim if dim is not None and dim >= 0 else None
-    return None
+    from deepspeed_tpu.runtime.zero.partition import (EXPERT_PARAM_PATTERN,
+                                                      tp_dim_for, tp_rule_kind)
+    kind = tp_rule_kind(param_name.lower(), rules)
+    if kind is None:
+        return None
+    expert_stacked = (re.search(EXPERT_PARAM_PATTERN, param_name.lower())
+                      is not None and ndim >= 3)
+    dim = tp_dim_for(kind, ndim, expert_stacked=expert_stacked)
+    return dim if dim is not None and dim >= 0 else None
 
 
 def reshape_flat_state_dict(flat, source_degree, target_degree, rules=None):

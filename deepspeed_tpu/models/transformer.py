@@ -67,6 +67,13 @@ class TransformerConfig:
     remat_policy: str = "nothing_saveable"
     scan_layers: bool = True
 
+    def __post_init__(self):
+        if self.fused_qkv and self.kv_heads != self.num_heads:
+            logger.warning(
+                "fused_qkv requested but num_kv_heads != num_heads (GQA) — "
+                "falling back to separate q/k/v projections; the param tree "
+                "will carry q_proj/k_proj/v_proj, not qkv_proj")
+
     @property
     def kv_heads(self):
         return self.num_kv_heads or self.num_heads
@@ -298,11 +305,6 @@ class Attention(nn.Module):
         D, H, KVH = cfg.head_dim, cfg.num_heads, cfg.kv_heads
         dense = partial(nn.DenseGeneral, use_bias=cfg.attn_bias_enabled,
                         dtype=cfg.jnp_dtype, param_dtype=jnp.float32)
-        if cfg.fused_qkv and KVH != H:
-            logger.warning(
-                "fused_qkv requested but num_kv_heads != num_heads (GQA) — "
-                "falling back to separate q/k/v projections; the param tree "
-                "will carry q_proj/k_proj/v_proj, not qkv_proj")
         if cfg.fused_qkv and KVH == H:
             # one [h, 3·H·D] gemm instead of three [h, H·D] gemms — better
             # MXU utilization at small hidden sizes (checkpoint conversion
@@ -310,6 +312,7 @@ class Attention(nn.Module):
             qkv = dense(features=(3, H, D), name="qkv_proj")(x)
             q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
         else:
+            # fused_qkv with GQA falls back (warned once at config time)
             q = dense(features=(H, D), name="q_proj")(x)
             k = dense(features=(KVH, D), name="k_proj")(x)
             v = dense(features=(KVH, D), name="v_proj")(x)

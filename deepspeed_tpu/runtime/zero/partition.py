@@ -125,7 +125,33 @@ def path_to_str(path):
     return "/".join(parts)
 
 
-def tp_spec_for(path_str, shape, mesh, rules=None):
+def tp_dim_for(kind, ndim, expert_stacked=False):
+    """The ONE source of truth mapping a rule kind to the sharded dim —
+    shared by runtime placement (``tp_spec_for``) and offline checkpoint
+    surgery (``checkpoint/reshape_utils.infer_tp_dim``), which must agree by
+    construction.
+
+    col → output dim: last dim of a 2-D kernel; the HEAD dim (ndim-2) of a
+    ≥3-D DenseGeneral kernel (whole heads per tp rank, Megatron layout).
+    row → first input dim (dim 0).  ``expert_stacked`` strips the leading
+    expert dim first (stacked MoE params shard their PER-EXPERT shape)."""
+    if expert_stacked:
+        inner = tp_dim_for(kind, ndim - 1)
+        return None if inner is None else inner + 1
+    col_dim = ndim - 1 if ndim == 2 else ndim - 2
+    return {"col": col_dim, "row": 0, "vocab": 0}.get(kind)
+
+
+def tp_rule_kind(path_str, rules=None):
+    rules = rules if rules is not None else DEFAULT_TP_RULES
+    low = path_str.lower()
+    for pattern, kind in rules:
+        if re.search(pattern, low):
+            return kind
+    return None
+
+
+def tp_spec_for(path_str, shape, mesh, rules=None, expert_stacked=False):
     """PartitionSpec from TP rules for one leaf.  A rule only applies when
     the target dim is divisible by the tp size (e.g. odd vocab sizes stay
     replicated — the reference pads instead, ``replace_module.py`` weight
@@ -139,12 +165,7 @@ def tp_spec_for(path_str, shape, mesh, rules=None):
     for pattern, kind in rules:
         if re.search(pattern, low):
             spec = [None] * ndim
-            # column-parallel: shard the output dim — for DenseGeneral
-            # kernels [in, ..., H, D] that's the HEAD dim (ndim-2), so whole
-            # heads land per tp rank (Megatron layout), not split head_dims.
-            # row-parallel: shard the (first) input dim.
-            col_dim = ndim - 1 if ndim == 2 else ndim - 2
-            dim = {"col": col_dim, "row": 0, "vocab": 0}.get(kind)
+            dim = tp_dim_for(kind, ndim, expert_stacked=expert_stacked)
             if dim is not None and dim >= 0 and shape[dim] % tp_size == 0:
                 spec[dim] = TP_AXIS
             # "replicate" (or non-divisible) leaves all None
@@ -221,8 +242,9 @@ def build_sharding_plan(abstract_params, topo, zero_config, tp_rules=None):
     def specs_for(path, leaf, shard_over_zero):
         shape = leaf.shape
         ps = path_to_str(path)
-        if re.search(EXPERT_PARAM_PATTERN, ps.lower()) and len(shape) >= 1 \
-                and mesh.shape[EP_AXIS] > 1 and shape[0] % mesh.shape[EP_AXIS] == 0:
+        is_expert = re.search(EXPERT_PARAM_PATTERN, ps.lower()) is not None
+        if is_expert and len(shape) >= 1 and mesh.shape[EP_AXIS] > 1 \
+                and shape[0] % mesh.shape[EP_AXIS] == 0:
             # expert params: expert dim over 'ep', TP rules on the trailing
             # (per-expert) dims; ZeRO restricted to edp — expert grads must
             # never average across experts (reference ``stage_1_and_2.py:1781``
@@ -232,7 +254,10 @@ def build_sharding_plan(abstract_params, topo, zero_config, tp_rules=None):
             if shard_over_zero:
                 spec = apply_zero_to_spec(shape, spec, mesh, (EDP_AXIS,))
             return spec
-        spec = tp_spec_for(ps, shape, mesh, tp_rules)
+        # stacked expert params keep per-expert TP dims even when the ep
+        # fast-path doesn't apply (ep=1 / non-divisible expert count)
+        spec = tp_spec_for(ps, shape, mesh, tp_rules,
+                           expert_stacked=is_expert and len(shape) >= 2)
         if shard_over_zero:
             spec = apply_zero_to_spec(shape, spec, mesh, zero_axes)
         return spec

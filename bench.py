@@ -32,7 +32,7 @@ def main():
 
     cfg = opt_config(model_name, max_seq_len=seq, dtype="bfloat16",
                      remat=True, remat_policy="dots_and_attn_saveable",
-                     scan_layers=False)
+                     scan_layers=False, loss_seq_chunks=8)
     model = deepspeed_tpu.models.transformer.Transformer(cfg)
     engine, *_ = deepspeed_tpu.initialize(
         model=model,

@@ -71,14 +71,13 @@ def infer_tp_dim(param_name, ndim, rules=None):
     """
     if ndim < 2:
         return None
-    from deepspeed_tpu.runtime.zero.partition import (EXPERT_PARAM_PATTERN,
+    from deepspeed_tpu.runtime.zero.partition import (is_expert_stacked,
                                                       tp_dim_for, tp_rule_kind)
     kind = tp_rule_kind(param_name.lower(), rules)
     if kind is None:
         return None
-    expert_stacked = (re.search(EXPERT_PARAM_PATTERN, param_name.lower())
-                      is not None and ndim >= 3)
-    dim = tp_dim_for(kind, ndim, expert_stacked=expert_stacked)
+    dim = tp_dim_for(kind, ndim,
+                     expert_stacked=is_expert_stacked(param_name, ndim))
     return dim if dim is not None and dim >= 0 else None
 
 

@@ -58,6 +58,10 @@ class TransformerConfig:
     dtype: str = "bfloat16"
     use_flash_attention: bool = True
     fused_qkv: bool = False                  # single fused QKV gemm (MHA only)
+    # >1: sequence-chunked cross-entropy — the [B,S,V] logits tensor never
+    # materializes (per-chunk head matmul + CE under jax.checkpoint); cuts
+    # the loss section's HBM traffic at large vocabularies
+    loss_seq_chunks: int = 0
     sparse_attention: Optional[object] = None  # SparsityConfig → block-sparse
     # "ulysses" | "ring" routes training attention through explicit
     # sequence-parallel collectives over the live sp mesh axis; None leaves
@@ -497,6 +501,15 @@ class Transformer(nn.Module):
             input_ids, labels, mask = batch, None, None
         if labels is None:
             labels = derive_causal_labels(input_ids, mask)
+        C = self.config.loss_seq_chunks
+        if C > 1:
+            if input_ids.shape[1] % C == 0:
+                h = self.hidden_states(input_ids, mask)
+                return chunked_cross_entropy_loss(h, labels, self._head, C)
+            logger.warning(
+                f"loss_seq_chunks={C} does not divide seq_len="
+                f"{input_ids.shape[1]} — falling back to full-logits loss "
+                f"(materializes the [B,S,V] tensor)")
         logits = self.logits(input_ids, mask)
         return cross_entropy_loss(logits, labels)
 
@@ -512,6 +525,31 @@ def derive_causal_labels(input_ids, attention_mask=None, ignore_index=-100):
                             constant_values=0)
         labels = jnp.where(next_mask.astype(bool), labels, ignore_index)
     return labels
+
+
+def chunked_cross_entropy_loss(h, labels, head_fn, n_chunks,
+                               ignore_index=-100):
+    """Sequence-chunked causal-LM loss: the head matmul + CE run per chunk
+    under ``jax.checkpoint`` so only one chunk's [B, S/C, V] logits is ever
+    live (fwd or bwd) — the backward recomputes each chunk's logits instead
+    of storing the full [B, S, V] fp32 tensor.  Matches
+    ``cross_entropy_loss`` exactly (sum-of-nll / count composition)."""
+    B, S, _ = h.shape
+    hc = h.reshape(B, n_chunks, S // n_chunks, h.shape[-1]).transpose(1, 0, 2, 3)
+    lc = labels.reshape(B, n_chunks, S // n_chunks).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def one(args):
+        hb, lb = args
+        logits = head_fn(hb).astype(jnp.float32)
+        valid = lb != ignore_index
+        safe = jnp.where(valid, lb, 0)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        return jnp.sum((logz - gold) * valid), jnp.sum(valid)
+
+    sums, counts = jax.lax.map(one, (hc, lc))
+    return jnp.sum(sums) / jnp.maximum(jnp.sum(counts), 1)
 
 
 def cross_entropy_loss(logits, labels, ignore_index=-100, z_loss=0.0):

@@ -63,13 +63,16 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
             cast = self.compute_dtype
             # inference placement: keep TP sharding, drop ZeRO scattering
             # (replicate over dp) so each decode step is gather-free.
-            from deepspeed_tpu.runtime.zero.partition import tp_spec_for, \
-                path_to_str
+            from deepspeed_tpu.runtime.zero.partition import (
+                is_expert_stacked, path_to_str, tp_spec_for)
 
             def spec_of(path, leaf):
+                ps = path_to_str(path)
                 return NamedSharding(
-                    self.mesh, tp_spec_for(path_to_str(path), leaf.shape,
-                                           self.mesh))
+                    self.mesh,
+                    tp_spec_for(ps, leaf.shape, self.mesh,
+                                expert_stacked=is_expert_stacked(
+                                    ps, len(leaf.shape))))
             abstract = jax.tree.map(
                 lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype),
                 self._params)

@@ -137,9 +137,20 @@ def tp_dim_for(kind, ndim, expert_stacked=False):
     expert dim first (stacked MoE params shard their PER-EXPERT shape)."""
     if expert_stacked:
         inner = tp_dim_for(kind, ndim - 1)
-        return None if inner is None else inner + 1
+        # a per-expert shape too small to shard must NOT fall back onto the
+        # expert dim
+        return None if inner is None or inner < 0 else inner + 1
     col_dim = ndim - 1 if ndim == 2 else ndim - 2
-    return {"col": col_dim, "row": 0, "vocab": 0}.get(kind)
+    dim = {"col": col_dim, "row": 0, "vocab": 0}.get(kind)
+    return None if dim is not None and dim < 0 else dim
+
+
+def is_expert_stacked(path_str, ndim):
+    """Shared predicate: does this leaf carry a leading stacked-expert dim?
+    Used by runtime placement AND checkpoint surgery — one definition so
+    they cannot disagree."""
+    return re.search(EXPERT_PARAM_PATTERN, path_str.lower()) is not None \
+        and ndim >= 2
 
 
 def tp_rule_kind(path_str, rules=None):
@@ -160,16 +171,14 @@ def tp_spec_for(path_str, shape, mesh, rules=None, expert_stacked=False):
     tp_size = mesh.shape.get(TP_AXIS, 1)
     if tp_size == 1:
         return P(*([None] * ndim))
-    rules = rules if rules is not None else DEFAULT_TP_RULES
-    low = path_str.lower()
-    for pattern, kind in rules:
-        if re.search(pattern, low):
-            spec = [None] * ndim
-            dim = tp_dim_for(kind, ndim, expert_stacked=expert_stacked)
-            if dim is not None and dim >= 0 and shape[dim] % tp_size == 0:
-                spec[dim] = TP_AXIS
-            # "replicate" (or non-divisible) leaves all None
-            return P(*spec)
+    kind = tp_rule_kind(path_str, rules)
+    if kind is not None:
+        spec = [None] * ndim
+        dim = tp_dim_for(kind, ndim, expert_stacked=expert_stacked)
+        # "replicate" (or non-divisible) leaves all None
+        if dim is not None and dim >= 0 and shape[dim] % tp_size == 0:
+            spec[dim] = TP_AXIS
+        return P(*spec)
     return P(*([None] * ndim))
 
 
@@ -257,7 +266,7 @@ def build_sharding_plan(abstract_params, topo, zero_config, tp_rules=None):
         # stacked expert params keep per-expert TP dims even when the ep
         # fast-path doesn't apply (ep=1 / non-divisible expert count)
         spec = tp_spec_for(ps, shape, mesh, tp_rules,
-                           expert_stacked=is_expert and len(shape) >= 2)
+                           expert_stacked=is_expert_stacked(ps, len(shape)))
         if shard_over_zero:
             spec = apply_zero_to_spec(shape, spec, mesh, zero_axes)
         return spec

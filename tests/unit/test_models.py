@@ -149,3 +149,28 @@ def test_fused_qkv_trains_and_infers():
     assert logits.shape == (2, 4, 128)
     g = jax.grad(lambda p: model.apply(p, lm_batch()))(params)
     assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
+
+
+def test_chunked_cross_entropy_matches_full():
+    """loss_seq_chunks must reproduce the full-logits loss exactly (same
+    nll-sum / valid-count composition), values and gradients."""
+    cfg_full = tiny_config()
+    cfg_chunk = tiny_config(loss_seq_chunks=4)
+    model_full = Transformer(cfg_full)
+    model_chunk = Transformer(cfg_chunk)
+    batch = lm_batch(bs=2, seq=16)
+    params = model_full.init(jax.random.key(0), batch)
+    lf = float(model_full.apply(params, batch))
+    lc = float(model_chunk.apply(params, batch))
+    assert lc == pytest.approx(lf, rel=1e-5)
+    # with an attention mask (ignore_index positions)
+    mask = np.ones((2, 16), np.int32)
+    mask[:, 10:] = 0
+    mb = dict(batch, attention_mask=mask)
+    assert float(model_chunk.apply(params, mb)) == \
+        pytest.approx(float(model_full.apply(params, mb)), rel=1e-5)
+    gf = jax.grad(lambda p: model_full.apply(p, batch))(params)
+    gc = jax.grad(lambda p: model_chunk.apply(p, batch))(params)
+    for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gc)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)

@@ -154,6 +154,13 @@ def timed_op(fn):
         arg0 = args[0] if args else None
         if not comms_logger.enabled or _is_traced(arg0):
             return fn(*args, **kwargs)
+        # prof_all=False restricts logging to the prof_ops allowlist
+        # (reference comms_logger semantics)
+        if not getattr(comms_logger, "prof_all", True):
+            name = kwargs.get("log_name", fn.__name__)
+            allowed = getattr(comms_logger, "prof_ops", None) or []
+            if fn.__name__ not in allowed and name not in allowed:
+                return fn(*args, **kwargs)
         t0 = time.perf_counter()
         result = fn(*args, **kwargs)
         try:
@@ -178,6 +185,8 @@ def configure(deepspeed_config=None, enabled=None, prof_all=None, prof_ops=None,
         comms_logger.verbose = verbose
     if debug is not None:
         comms_logger.debug = debug
+    if prof_all is not None:
+        comms_logger.prof_all = prof_all
     if prof_ops is not None:
         comms_logger.prof_ops = prof_ops
 

@@ -51,7 +51,15 @@ class TransformerConfig:
     shared_attn_mlp_norm: bool = False       # gptj: one ln feeds both branches
     embedding_norm: bool = False             # bloom word_embeddings_layernorm
     attention_bias: Optional[bool] = None    # None → not rms_norm
+    attention_out_bias: Optional[bool] = None  # gpt-neo: o_proj biased, qkv not
     mlp_bias: Optional[bool] = None          # None → not rms_norm
+    # gpt-neo: per-layer "global"/"local" pattern + band width; local layers
+    # attend to the trailing `window_size` positions only.  Requires
+    # scan_layers=False (layers are no longer homogeneous).
+    attention_layers: Optional[tuple] = None
+    window_size: int = 256
+    # None → 1/sqrt(head_dim); gpt-neo uses 1.0 (unscaled logits)
+    attention_softmax_scale: Optional[float] = None
     lm_head_bias: bool = False               # gptj
     dropout: float = 0.0
     tie_word_embeddings: bool = False
@@ -72,6 +80,14 @@ class TransformerConfig:
     scan_layers: bool = True
 
     def __post_init__(self):
+        if self.attention_layers is not None:
+            if len(self.attention_layers) != self.num_layers:
+                raise ValueError(
+                    f"attention_layers has {len(self.attention_layers)} "
+                    f"entries for {self.num_layers} layers")
+            if self.scan_layers:
+                raise ValueError("attention_layers (per-layer local/global "
+                                 "patterns) requires scan_layers=False")
         if self.fused_qkv and self.kv_heads != self.num_heads:
             logger.warning(
                 "fused_qkv requested but num_kv_heads != num_heads (GQA) — "
@@ -94,6 +110,18 @@ class TransformerConfig:
     def attn_bias_enabled(self):
         return self.attention_bias if self.attention_bias is not None \
             else not self.rms_norm
+
+    @property
+    def attn_out_bias_enabled(self):
+        return self.attention_out_bias if self.attention_out_bias is not None \
+            else self.attn_bias_enabled
+
+    def window_for_layer(self, layer_idx):
+        """Band width for this layer, or None for full (global) attention."""
+        if self.attention_layers is None or layer_idx is None:
+            return None
+        return self.window_size \
+            if self.attention_layers[layer_idx] == "local" else None
 
     @property
     def mlp_bias_enabled(self):
@@ -197,10 +225,13 @@ def alibi_bias(n_heads, kv_len):
     return alibi_slopes(n_heads)[:, None] * jnp.arange(kv_len)[None, :]
 
 
-def reference_attention(q, k, v, causal=True, mask=None, bias=None):
+def reference_attention(q, k, v, causal=True, mask=None, bias=None,
+                        window=None):
     """jnp attention used as the CPU fallback and the golden reference for
     the Pallas kernel tests.  q,k,v: [B, S, H, D] / [B, S, KVH, D];
-    ``bias``: optional [H, T] additive logit bias (ALiBi)."""
+    ``bias``: optional [H, T] additive logit bias (ALiBi); ``window``:
+    optional band width (gpt-neo local attention — attend to the trailing
+    ``window`` positions only)."""
     B, S, H, D = q.shape
     KVH = k.shape[2]
     if KVH != H:
@@ -212,7 +243,11 @@ def reference_attention(q, k, v, causal=True, mask=None, bias=None):
     if bias is not None:
         logits = logits + bias[None, :, None, :].astype(jnp.float32)
     if causal:
-        causal_mask = jnp.tril(jnp.ones((S, k.shape[1]), dtype=bool))
+        rows = jnp.arange(S)[:, None]
+        cols = jnp.arange(k.shape[1])[None, :]
+        causal_mask = cols <= rows
+        if window is not None:
+            causal_mask = causal_mask & (cols > rows - window)
         logits = jnp.where(causal_mask[None, None], logits, -1e30)
     if mask is not None:
         logits = jnp.where(mask[:, None, None, :].astype(bool), logits, -1e30)
@@ -220,7 +255,12 @@ def reference_attention(q, k, v, causal=True, mask=None, bias=None):
     return jnp.einsum("bhst,bthd->bshd", probs, v)
 
 
-def _attention(q, k, v, config, mask=None, bias=None):
+def _attention(q, k, v, config, mask=None, bias=None, window=None):
+    if window is not None:
+        # banded local attention (gpt-neo): dense path with a band mask —
+        # the flash/sparse kernels are bypassed (HF computes it dense too)
+        return reference_attention(q, k, v, causal=True, mask=mask, bias=bias,
+                                   window=window)
     if config.sparse_attention is not None and q.shape[1] > 1 and bias is None:
         from deepspeed_tpu.ops.sparse_attention.block_sparse import (
             block_sparse_attention, cached_layout)
@@ -263,7 +303,8 @@ def _attention(q, k, v, config, mask=None, bias=None):
     return reference_attention(q, k, v, causal=True, mask=mask, bias=bias)
 
 
-def cached_attention(q, k_cache, v_cache, q_positions, bias=None):
+def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
+                     window=None):
     """Decode attention against a KV cache.
 
     q: [B, S, H, D]; caches: [B, KVH, S_max, D] (head-major); q_positions:
@@ -274,7 +315,7 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None):
     """
     B, S, H, D = q.shape
     KVH, S_max = k_cache.shape[1], k_cache.shape[2]
-    if S == 1 and bias is None:
+    if S == 1 and bias is None and window is None:
         # single-token decode: the Pallas online-softmax kernel streams the
         # cache blockwise instead of materializing [B,H,1,S_max] fp32 logits
         from deepspeed_tpu.ops.transformer.decode_attention import (
@@ -295,6 +336,9 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None):
         logits = logits + bias[None, :, None, :].astype(jnp.float32)
     kv_pos = jnp.arange(S_max)
     ok = q_positions[:, None, :, None] >= kv_pos[None, None, None, :]
+    if window is not None:
+        ok = ok & (kv_pos[None, None, None, :]
+                   > q_positions[:, None, :, None] - window)
     logits = jnp.where(ok, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhst,bhtd->bshd", probs, v_cache)
@@ -302,11 +346,13 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None):
 
 class Attention(nn.Module):
     config: TransformerConfig
+    layer_idx: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, positions, mask=None, cache=None):
         cfg = self.config
         D, H, KVH = cfg.head_dim, cfg.num_heads, cfg.kv_heads
+        window = cfg.window_for_layer(self.layer_idx)
         dense = partial(nn.DenseGeneral, use_bias=cfg.attn_bias_enabled,
                         dtype=cfg.jnp_dtype, param_dtype=jnp.float32)
         if cfg.fused_qkv and KVH == H:
@@ -324,6 +370,12 @@ class Attention(nn.Module):
             q, k = _rope(q, k, positions, D, cfg.rope_theta,
                          rope_dim=cfg.rope_dim,
                          interleaved=cfg.rope_interleaved)
+        if cfg.attention_softmax_scale is not None:
+            # every attention path divides by sqrt(D); fold any other scale
+            # (gpt-neo: 1.0, i.e. unscaled logits) into q up front so the
+            # flash/decode kernels need no changes
+            q = q * jnp.asarray(cfg.attention_softmax_scale * np.sqrt(D),
+                                q.dtype)
         bias = alibi_bias(H, cache["k"].shape[2] if cache is not None
                           else x.shape[1]) \
             if cfg.position_embedding == "alibi" else None
@@ -347,12 +399,15 @@ class Attention(nn.Module):
             v_cache = jax.lax.dynamic_update_slice(
                 cache["v"], v.transpose(0, 2, 1, 3).astype(cache["v"].dtype),
                 (0, 0, start, 0))
-            out = cached_attention(q, k_cache, v_cache, positions, bias=bias)
+            out = cached_attention(q, k_cache, v_cache, positions, bias=bias,
+                                   window=window)
             new_cache = {"k": k_cache, "v": v_cache}
         else:
-            out = _attention(q, k, v, cfg, mask=mask, bias=bias)
+            out = _attention(q, k, v, cfg, mask=mask, bias=bias,
+                             window=window)
             new_cache = None
-        proj = dense(features=cfg.hidden_size, axis=(-2, -1), name="o_proj")(
+        proj = dense(features=cfg.hidden_size, axis=(-2, -1),
+                     use_bias=cfg.attn_out_bias_enabled, name="o_proj")(
             out.reshape(*out.shape[:2], H, D))
         return proj, new_cache
 
@@ -379,13 +434,15 @@ class MLP(nn.Module):
 
 class Block(nn.Module):
     config: TransformerConfig
+    layer_idx: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, positions, mask=None, cache=None):
         cfg = self.config
         normed = _norm(cfg, "input_norm")(x).astype(cfg.jnp_dtype)
-        attn, new_cache = Attention(cfg, name="attn")(normed, positions, mask,
-                                                      cache)
+        attn, new_cache = Attention(cfg, layer_idx=self.layer_idx,
+                                    name="attn")(normed, positions, mask,
+                                                 cache)
         if cfg.parallel_residual:
             mlp_in = normed if cfg.shared_attn_mlp_norm else \
                 _norm(cfg, "post_attn_norm")(x).astype(cfg.jnp_dtype)
@@ -435,7 +492,7 @@ class Transformer(nn.Module):
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="layers")
         else:
-            self.block_list = [block(cfg, name=f"layers_{i}")
+            self.block_list = [block(cfg, layer_idx=i, name=f"layers_{i}")
                                for i in range(cfg.num_layers)]
         self.final_norm = _norm(cfg, "final_norm")
         if not cfg.tie_word_embeddings:

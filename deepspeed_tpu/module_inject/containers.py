@@ -305,6 +305,56 @@ class GPTJPolicy(HFPolicy):
         return out
 
 
+class GPTNeoPolicy(HFPolicy):
+    """EleutherAI/gpt-neo (reference ``containers/gptneo.py``): alternating
+    global/local (banded, window 256) attention layers, *unscaled* attention
+    logits, biasless q/k/v with a biased out-projection, Linear (not Conv1D)
+    MLP weights.  Per-layer attention patterns make the trunk heterogeneous,
+    so layers are emitted unstacked (``scan_layers=False``)."""
+
+    model_types = ("gpt_neo",)
+
+    def build_config(self, hf, **over):
+        over.pop("scan_layers", None)   # forced off: heterogeneous layers
+        base = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            num_layers=hf.num_layers,
+            num_heads=hf.num_heads,
+            ffn_hidden_size=(hf.intermediate_size or 4 * hf.hidden_size),
+            max_seq_len=hf.max_position_embeddings,
+            activation=ACT_MAP[hf.activation_function],
+            position_embedding="learned",
+            tie_word_embeddings=True,
+            attention_bias=False,         # q/k/v carry no bias...
+            attention_out_bias=True,      # ...but out_proj does
+            attention_softmax_scale=1.0,  # gpt-neo skips 1/sqrt(D)
+            attention_layers=tuple(hf.attention_layers),
+            window_size=hf.window_size,
+            layernorm_epsilon=hf.layer_norm_epsilon,
+            scan_layers=False,
+        )
+        base.update(over)
+        return TransformerConfig(**base)
+
+    def top_params(self, sd, cfg):
+        out = {"embed_tokens/embedding": _np(sd["transformer.wte.weight"]),
+               "embed_positions/embedding": _np(sd["transformer.wpe.weight"])}
+        out.update(self.norm(sd, "transformer.ln_f", "final_norm"))
+        return out
+
+    def layer_params(self, sd, i, cfg):
+        p = f"transformer.h.{i}"
+        out = self.attn_separate(sd, f"{p}.attn.attention", cfg)
+        out.update(self.norm(sd, f"{p}.ln_1", "input_norm"))
+        out.update(self.norm(sd, f"{p}.ln_2", "post_attn_norm"))
+        out["mlp/up_proj/kernel"] = linear_kernel(sd[f"{p}.mlp.c_fc.weight"])
+        out["mlp/up_proj/bias"] = _np(sd[f"{p}.mlp.c_fc.bias"])
+        out["mlp/down_proj/kernel"] = linear_kernel(sd[f"{p}.mlp.c_proj.weight"])
+        out["mlp/down_proj/bias"] = _np(sd[f"{p}.mlp.c_proj.bias"])
+        return out
+
+
 class BertPolicy(HFPolicy):
     """bert-* (reference ``module_inject/replace_policy.py``
     HFBertLayerPolicy — the reference's inference test-matrix workhorse).
@@ -404,5 +454,87 @@ class BertPolicy(HFPolicy):
         return flat
 
 
+class DistilBertPolicy(BertPolicy):
+    """distilbert-* (reference ``containers/distil_bert.py``): BERT encoder
+    minus token-type embeddings; MLM head named vocab_transform /
+    vocab_layer_norm / vocab_projector (projector tied to embeddings)."""
+
+    model_types = ("distilbert",)
+
+    def build_config(self, hf, **over):
+        from deepspeed_tpu.models.bert import BertConfig
+        if hf.activation != "gelu":
+            raise NotImplementedError(
+                f"DistilBERT activation {hf.activation!r}: the fused encoder "
+                "layer is gelu-only")
+        base = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.dim,
+            num_layers=hf.n_layers,
+            num_heads=hf.n_heads,
+            intermediate_size=hf.hidden_dim,
+            max_position_embeddings=hf.max_position_embeddings,
+            type_vocab_size=1,           # none in distilbert; zero table
+            layer_norm_eps=1e-12,
+        )
+        if "max_seq_len" in over:
+            over["max_position_embeddings"] = over.pop("max_seq_len")
+        base.update(over)
+        return BertConfig(**base)
+
+    def convert(self, sd, cfg):
+        H = cfg.num_heads
+        D = cfg.hidden_size // H
+        pfx = "distilbert." if any(k.startswith("distilbert.") for k in sd) \
+            else ""
+        flat = {
+            "bert/embeddings/word_embeddings/embedding":
+                _np(sd[f"{pfx}embeddings.word_embeddings.weight"]),
+            "bert/embeddings/position_embeddings/embedding":
+                _np(sd[f"{pfx}embeddings.position_embeddings.weight"]),
+            # distilbert has no segment embeddings: zero table, index 0
+            "bert/embeddings/token_type_embeddings/embedding":
+                np.zeros((1, cfg.hidden_size), np.float32),
+            "bert/embeddings/layer_norm/scale":
+                _np(sd[f"{pfx}embeddings.LayerNorm.weight"]),
+            "bert/embeddings/layer_norm/bias":
+                _np(sd[f"{pfx}embeddings.LayerNorm.bias"]),
+        }
+        for i in range(cfg.num_layers):
+            p = f"{pfx}transformer.layer.{i}"
+            o = f"bert/layers_{i}"
+            for std, src in (("q_proj", "q_lin"), ("k_proj", "k_lin"),
+                             ("v_proj", "v_lin")):
+                flat[f"{o}/{std}/kernel"] = qkv_kernel(
+                    sd[f"{p}.attention.{src}.weight"], H, D)
+                flat[f"{o}/{std}/bias"] = qkv_bias(
+                    sd[f"{p}.attention.{src}.bias"], H, D)
+            flat[f"{o}/out_proj/kernel"] = linear_kernel(
+                sd[f"{p}.attention.out_lin.weight"])
+            flat[f"{o}/out_proj/bias"] = _np(sd[f"{p}.attention.out_lin.bias"])
+            flat[f"{o}/attn_ln/scale"] = _np(sd[f"{p}.sa_layer_norm.weight"])
+            flat[f"{o}/attn_ln/bias"] = _np(sd[f"{p}.sa_layer_norm.bias"])
+            flat[f"{o}/intermediate/kernel"] = linear_kernel(
+                sd[f"{p}.ffn.lin1.weight"])
+            flat[f"{o}/intermediate/bias"] = _np(sd[f"{p}.ffn.lin1.bias"])
+            flat[f"{o}/output/kernel"] = linear_kernel(
+                sd[f"{p}.ffn.lin2.weight"])
+            flat[f"{o}/output/bias"] = _np(sd[f"{p}.ffn.lin2.bias"])
+            flat[f"{o}/mlp_ln/scale"] = _np(
+                sd[f"{p}.output_layer_norm.weight"])
+            flat[f"{o}/mlp_ln/bias"] = _np(sd[f"{p}.output_layer_norm.bias"])
+        self._has_mlm_head = "vocab_transform.weight" in sd
+        self._has_pooler = False
+        if self._has_mlm_head:
+            flat["transform_dense/kernel"] = linear_kernel(
+                sd["vocab_transform.weight"])
+            flat["transform_dense/bias"] = _np(sd["vocab_transform.bias"])
+            flat["transform_ln/scale"] = _np(sd["vocab_layer_norm.weight"])
+            flat["transform_ln/bias"] = _np(sd["vocab_layer_norm.bias"])
+            flat["decoder_bias"] = _np(sd["vocab_projector.bias"])
+        return flat
+
+
 ALL_POLICIES = [OPTPolicy, GPT2Policy, LlamaPolicy, BloomPolicy,
-                GPTNeoXPolicy, GPTJPolicy, BertPolicy]
+                GPTNeoXPolicy, GPTJPolicy, GPTNeoPolicy, BertPolicy,
+                DistilBertPolicy]

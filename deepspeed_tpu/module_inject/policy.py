@@ -121,11 +121,17 @@ class HFPolicy:
         raise NotImplementedError
 
     def convert(self, sd, cfg):
-        """Full flat param dict {path: np.ndarray} with scanned layers
-        stacked on a leading layer axis."""
+        """Full flat param dict {path: np.ndarray}: scanned layers stack on a
+        leading layer axis ('layers/...'); with ``scan_layers=False`` each
+        layer keeps its own 'layers_{i}/...' paths."""
         flat = dict(self.top_params(sd, cfg))
         per_layer = [self.layer_params(sd, i, cfg)
                      for i in range(cfg.num_layers)]
+        if not getattr(cfg, "scan_layers", True):
+            for i, lp in enumerate(per_layer):
+                for key, val in lp.items():
+                    flat[f"layers_{i}/{key}"] = val
+            return flat
         keys = set(per_layer[0].keys())
         for i, lp in enumerate(per_layer):
             if set(lp.keys()) != keys:

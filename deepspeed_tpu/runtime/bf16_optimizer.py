@@ -1,0 +1,144 @@
+"""BF16_Optimizer — bf16 working weights over fp32 masters, no loss scaling.
+
+Reference parity: ``runtime/bf16_optimizer.py:30`` (``BF16_Optimizer``): fp32
+master params partitioned ZeRO-1-style over the DP group (``:87-165``), bf16
+working copies, fp32 gradient accumulation, global-norm clipping, and a unit
+loss scale (bf16's exponent range makes dynamic scaling unnecessary).
+
+TPU redesign: in production the engine's fused train step IS this optimizer —
+masters/opt-state carry ZeRO sharding annotations from
+``runtime/zero/partition.py`` and XLA emits the reduce-scatter/all-gather.
+This standalone class exists for reference-API users and tests: functional
+state, one jitted update, optional master/opt-state sharding over the live
+``dp`` mesh axis (the ZeRO-1 partitioning of the reference).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+class BF16_Optimizer:
+
+    def __init__(self, init_optimizer, params=None, mpu=None, clip_grad=0.0,
+                 norm_type=2, allgather_bucket_size=None, dp_process_group=None,
+                 timers=None, shard_masters=True):
+        if norm_type != 2:
+            raise NotImplementedError("only L2 grad-norm clipping")
+        self.optimizer = init_optimizer
+        self.clip_grad = float(clip_grad or 0.0)
+        self.shard_masters = shard_masters
+        self.fp32_groups_flat = None
+        self.opt_state = None
+        self.step_count = 0
+        self.overflow = False          # bf16 runs unit scale; kept for API
+        self._accum_grads = None
+        if params is not None:
+            self.initialize_masters(params)
+
+    # -------------------------------------------------------------- #
+    def _master_shardings(self, masters):
+        """ZeRO-1-style partitioning of masters/opt-state over the dp axis
+        (reference ``bf16_optimizer.py:87-165``) — on TPU this is a sharding
+        annotation, applied only when a multi-device topology is live."""
+        from deepspeed_tpu.parallel.topology import get_topology
+        topo = get_topology()
+        if topo is None or not self.shard_masters:
+            return None
+        mesh = topo.mesh
+        dp_axes = tuple(a for a in ("dp", "edp") if mesh.shape.get(a, 1) > 1)
+        if not dp_axes:
+            return None
+        from deepspeed_tpu.runtime.zero.partition import (apply_zero_to_spec,
+                                                          choose_zero_dim)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def sh(leaf):
+            spec = apply_zero_to_spec(leaf.shape, P(*([None] * leaf.ndim)),
+                                      mesh, dp_axes)
+            return NamedSharding(mesh, spec)
+        return jax.tree.map(sh, masters)
+
+    def initialize_masters(self, bf16_params):
+        self.fp32_groups_flat = jax.tree.map(
+            lambda p: jnp.asarray(p, jnp.float32), bf16_params)
+        shardings = self._master_shardings(self.fp32_groups_flat)
+        if shardings is not None:
+            self.fp32_groups_flat = jax.tree.map(
+                jax.device_put, self.fp32_groups_flat, shardings)
+        self.opt_state = self.optimizer.init(self.fp32_groups_flat)
+
+    @property
+    def cur_scale(self):
+        return 1.0
+
+    def scale_loss(self, loss):
+        return loss                    # unit scale
+
+    def backward(self, grads):
+        """Stage grads; repeated calls accumulate in fp32 (the reference
+        accumulates bf16 grads into fp32 buffers across GAS boundaries)."""
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        if self._accum_grads is None:
+            self._accum_grads = grads
+        else:
+            self._accum_grads = jax.tree.map(jnp.add, self._accum_grads, grads)
+
+    # -------------------------------------------------------------- #
+    def _step_fn(self):
+        clip = self.clip_grad
+        opt = self.optimizer
+
+        def step(masters, opt_state, grads, step_no):
+            flat = jax.tree.leaves(grads)
+            gnorm = jnp.sqrt(sum(jnp.sum(g * g) for g in flat))
+            if clip > 0:
+                factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * factor, grads)
+            new_masters, new_opt = opt.update(grads, opt_state, masters,
+                                              step=step_no)
+            return new_masters, new_opt, gnorm
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def step(self, closure=None):
+        assert self._accum_grads is not None, "backward() not called"
+        assert self.fp32_groups_flat is not None, \
+            "initialize_masters() not called"
+        if not hasattr(self, "_jitted_step"):
+            self._jitted_step = self._step_fn()
+        self.step_count += 1
+        (self.fp32_groups_flat, self.opt_state,
+         self._last_norm) = self._jitted_step(
+            self.fp32_groups_flat, self.opt_state, self._accum_grads,
+            jnp.asarray(self.step_count, jnp.int32))
+        self._accum_grads = None
+        return False                   # never overflows (unit scale)
+
+    # -------------------------------------------------------------- #
+    def get_bf16_params(self):
+        """Current working (bf16) weights derived from the masters — the
+        all-gathered update the reference broadcasts back to the model."""
+        return jax.tree.map(lambda p: p.astype(jnp.bfloat16),
+                            self.fp32_groups_flat)
+
+    def state_dict(self):
+        return {
+            "step": self.step_count,
+            "fp32_groups_flat": jax.device_get(self.fp32_groups_flat),
+            "optimizer_state": jax.device_get(self.opt_state),
+        }
+
+    def load_state_dict(self, sd, load_optimizer_states=True):
+        self.step_count = sd["step"]
+        self.fp32_groups_flat = jax.tree.map(jnp.asarray,
+                                             sd["fp32_groups_flat"])
+        shardings = self._master_shardings(self.fp32_groups_flat)
+        if shardings is not None:   # restore the ZeRO-1 dp partitioning
+            self.fp32_groups_flat = jax.tree.map(
+                jax.device_put, self.fp32_groups_flat, shardings)
+        if load_optimizer_states and sd.get("optimizer_state") is not None:
+            opt = sd["optimizer_state"]
+            if self.opt_state is not None and hasattr(self.opt_state, "_fields") \
+                    and isinstance(opt, dict):
+                opt = type(self.opt_state)(**opt)
+            self.opt_state = opt

@@ -117,3 +117,24 @@ def test_bert_through_init_inference():
                       jnp.int32)
     out = engine.forward(ids)
     assert out.shape == (1, 8, 128)
+
+
+def test_distilbert_logit_parity_with_hf():
+    """DistilBERT (no token-type embeddings, vocab_transform/-projector MLM
+    head) converts onto the same fused encoder stack."""
+    import torch
+    import transformers
+    from deepspeed_tpu.module_inject.replace_module import convert_hf_model
+    torch.manual_seed(3)
+    cfg = transformers.DistilBertConfig(
+        vocab_size=128, dim=32, n_layers=2, n_heads=4, hidden_dim=64,
+        max_position_embeddings=64, dropout=0.0, attention_dropout=0.0)
+    hf = transformers.DistilBertForMaskedLM(cfg).eval()
+    model, params = convert_hf_model(hf, dtype="float32")
+    assert isinstance(model, BertForMaskedLM)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, (2, 10)).astype(np.int32)
+    with torch.no_grad():
+        want = hf(input_ids=torch.tensor(ids.astype(np.int64))).logits.numpy()
+    got = np.asarray(model.apply(params, {"input_ids": jnp.asarray(ids)}))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)

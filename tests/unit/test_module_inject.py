@@ -61,6 +61,15 @@ def tiny_hf_model(model_type):
             n_head=t["heads"], n_positions=64, rotary_dim=4,
             n_inner=t["ffn"])
         return transformers.GPTJForCausalLM(cfg)
+    if model_type == "gpt_neo":
+        # window_size < seq so the local layer's band mask really bites
+        cfg = transformers.GPTNeoConfig(
+            vocab_size=t["vocab"], hidden_size=t["hidden"],
+            num_layers=t["layers"], num_heads=t["heads"],
+            intermediate_size=t["ffn"], max_position_embeddings=64,
+            attention_types=[[["global", "local"], t["layers"] // 2]],
+            window_size=5)
+        return transformers.GPTNeoForCausalLM(cfg)
     raise ValueError(model_type)
 
 
@@ -70,7 +79,7 @@ def hf_logits(hf_model, ids):
         return hf_model(torch.from_numpy(ids)).logits.float().numpy()
 
 
-ARCHS = ["opt", "gpt2", "llama", "bloom", "gpt_neox", "gptj"]
+ARCHS = ["opt", "gpt2", "llama", "bloom", "gpt_neox", "gptj", "gpt_neo"]
 
 
 @pytest.mark.parametrize("arch", ARCHS)
@@ -89,7 +98,7 @@ def test_hf_logit_parity(arch):
     np.testing.assert_allclose(got, expected, atol=1e-4, rtol=1e-4)
 
 
-@pytest.mark.parametrize("arch", ["opt", "llama"])
+@pytest.mark.parametrize("arch", ["opt", "llama", "gpt_neo"])
 def test_decode_matches_full_forward(arch):
     """KV-cached incremental decode must reproduce full-context logits."""
     from deepspeed_tpu.model_implementations import DeepSpeedTransformerInference

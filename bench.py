@@ -30,9 +30,16 @@ def main():
     platform = jax.devices()[0].platform
     n_dev = jax.device_count()
 
-    cfg = opt_config(model_name, max_seq_len=seq, dtype="bfloat16",
-                     remat=True, remat_policy="dots_and_attn_saveable",
-                     scan_layers=False, loss_seq_chunks=8)
+    cfg = opt_config(
+        model_name, max_seq_len=seq, dtype="bfloat16",
+        # remat off is the fastest fit for 350m @ bs4 on one v5e chip
+        # (38.0% vs 35.3% MFU measured); larger models re-enable via env
+        remat=os.environ.get("BENCH_REMAT", "0") == "1",
+        remat_policy=os.environ.get("BENCH_REMAT_POLICY",
+                                    "dots_and_attn_saveable"),
+        scan_layers=os.environ.get("BENCH_SCAN", "0") == "1",
+        fused_qkv=os.environ.get("BENCH_FQ", "0") == "1",
+        loss_seq_chunks=int(os.environ.get("BENCH_LOSS_CHUNKS", "8")))
     model = deepspeed_tpu.models.transformer.Transformer(cfg)
     engine, *_ = deepspeed_tpu.initialize(
         model=model,

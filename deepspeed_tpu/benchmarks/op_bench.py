@@ -1,0 +1,106 @@
+"""Op-level micro-benchmarks — the analog of reference ``tests/perf/``
+(``adam_test.py`` op-speed measurement) plus kernel throughput for the Pallas
+hot paths.  Run as a CLI; prints one JSON line per op.
+
+Timing protocol mirrors ``bench.py``: through the axon tunnel
+``block_until_ready`` can return early, so every measurement closes with a
+dependent ``device_get`` of a scalar derived from the op's output.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _sync_scalar(x):
+    import jax
+    import jax.numpy as jnp
+    return float(jax.device_get(jnp.sum(jax.tree.leaves(x)[0][..., :1])))
+
+
+def _timeit(fn, args, iters):
+    out = fn(*args)          # compile
+    _sync_scalar(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync_scalar(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_adam(numel=50_000_000, iters=10):
+    """Fused Adam update throughput (reference tests/perf/adam_test.py)."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.adam.fused_adam import FusedAdamW
+
+    opt = FusedAdamW(lr=1e-4)
+    params = {"w": jnp.ones((numel,), jnp.float32)}
+    grads = {"w": jnp.full((numel,), 1e-3, jnp.float32)}
+    state = opt.init(params)
+    step = jax.jit(lambda g, s, p: opt.update(g, s, p, step=1))
+    dt = _timeit(step, (grads, state, params), iters)
+    # adam reads p,g,m,v and writes p,m,v: 7 fp32 streams
+    gbps = 7 * numel * 4 / dt / 1e9
+    return {"op": "fused_adamw", "numel": numel, "ms": round(dt * 1e3, 3),
+            "effective_GB/s": round(gbps, 1)}
+
+
+def bench_flash_attention(b=4, s=2048, h=16, d=64, iters=10, bwd=False):
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
+
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+               for _ in range(3))
+    if bwd:
+        f = jax.jit(jax.grad(lambda q, k, v: flash_attention(
+            q, k, v, causal=True).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+    else:
+        f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    dt = _timeit(f, (q, k, v), iters)
+    # causal attention flops: 2 gemms, half the square
+    flops = (2 * 2 * b * h * s * s * d) / 2 * (3.5 if bwd else 1)
+    return {"op": f"flash_attention_{'bwd' if bwd else 'fwd'}",
+            "shape": [b, s, h, d], "ms": round(dt * 1e3, 3),
+            "TFLOP/s": round(flops / dt / 1e12, 2)}
+
+
+def bench_quantizer(numel=64 * 1024 * 1024, bits=8, iters=10):
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.quantizer.kernels import quantize, dequantize
+
+    x = jnp.ones((numel,), jnp.bfloat16)
+    groups = numel // 2048
+    f = jax.jit(lambda t: dequantize(*quantize(t, groups, num_bits=bits),
+                                     num_bits=bits))
+    dt = _timeit(f, (x,), iters)
+    return {"op": f"quant_dequant_int{bits}", "numel": numel,
+            "ms": round(dt * 1e3, 3),
+            "GB/s": round(numel * 2 / dt / 1e9, 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default="adam,flash_fwd,flash_bwd,quant")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+    runners = {
+        "adam": lambda: bench_adam(iters=args.iters),
+        "flash_fwd": lambda: bench_flash_attention(iters=args.iters),
+        "flash_bwd": lambda: bench_flash_attention(iters=args.iters, bwd=True),
+        "quant": lambda: bench_quantizer(iters=args.iters),
+    }
+    for name in args.ops.split(","):
+        try:
+            print(json.dumps(runners[name.strip()]()))
+        except Exception as e:          # keep sweeping (parity: ds_bench)
+            print(json.dumps({"op": name, "error": str(e)[:200]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -320,6 +320,64 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None):
     return all_reduce(tensor, op=op, group=group)
 
 
+def all_gather(tensor_list_or_tensor, tensor=None, group=None, log_name=None):
+    """List-style all_gather (reference ``comm.py:284``): returns the gathered
+    shards stacked on a leading axis.  ``tensor_list_or_tensor`` may be the
+    torch-style output list (ignored — jax is functional) or the input.
+    Timing is owned by the inner ``all_gather_into_tensor`` (one log record
+    per call, not two)."""
+    x = tensor if tensor is not None else tensor_list_or_tensor
+    return all_gather_into_tensor(x, group=group, axis=0, tiled=False,
+                                  log_name=log_name or "all_gather")
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, log_name=None):
+    """Gather-to-dst (reference ``comm.py:362``).  On a mesh the all-gather
+    result is available everywhere; ``dst`` is vestigial.  Timing owned by
+    the inner collective."""
+    return all_gather_into_tensor(tensor, group=group, axis=0, tiled=False,
+                                  log_name=log_name or "gather")
+
+
+@timed_op
+def scatter(tensor, scatter_list=None, src=0, group=None, log_name=None):
+    """Scatter-from-src (reference ``comm.py:375``): each participant takes
+    its own slice of src's leading axis (src's value is authoritative via
+    broadcast; on a mesh all copies already agree)."""
+    axes = _axes(group)
+    if not _is_traced(tensor):
+        raise RuntimeError("scatter is a device collective: call inside "
+                           "shard_map/jit")
+    idx = axis_index(axes)
+    return jax.lax.dynamic_index_in_dim(tensor, idx, axis=0, keepdims=False)
+
+
+def isend(tensor, dst, group=None, tag=0):
+    """Async point-to-point (reference ``comm.py:420``).  TPU p2p is a
+    compiled ``ppermute``; the 'async' handle is the value itself (XLA
+    overlaps it) — pair with :func:`ppermute` for real stage transfer."""
+    raise NotImplementedError(
+        "isend/irecv have no eager analog on TPU: use ppermute / "
+        "send_recv_next inside shard_map (pipeline p2p rides ICI)")
+
+
+irecv = isend
+send = isend
+recv = isend
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
+    """Barrier with failure attribution (reference ``comm.py:405``).  XLA
+    collectives already fail loudly on rank drop-out; delegate to barrier."""
+    return barrier(group)
+
+
+def inference_all_reduce(tensor, op=ReduceOp.SUM, group=None):
+    """TP allreduce inside injected inference layers (reference
+    ``pt_binding.cpp`` inference_all_reduce) — same psum on TPU."""
+    return all_reduce(tensor, op=op, group=group)
+
+
 def destroy_process_group():
     global cdb
     if cdb is not None:

@@ -60,6 +60,16 @@ class TransformerConfig:
     window_size: int = 256
     # None → 1/sqrt(head_dim); gpt-neo uses 1.0 (unscaled logits)
     attention_softmax_scale: Optional[float] = None
+    # MoE trunk (reference Megatron-DeepSpeed MoE-GPT layout): every
+    # `moe_every`-th block swaps its MLP for a `moe/layer.py` MoE with
+    # `moe_num_experts` experts sharded over the `ep` mesh axis.  0 = dense.
+    moe_num_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_eval_capacity_factor: float = 1.0
+    moe_ep_size: int = 1
+    moe_aux_coef: float = 0.01
     lm_head_bias: bool = False               # gptj
     dropout: float = 0.0
     tie_word_embeddings: bool = False
@@ -80,6 +90,10 @@ class TransformerConfig:
     scan_layers: bool = True
 
     def __post_init__(self):
+        if self.moe_num_experts > 0 and self.scan_layers:
+            raise ValueError("MoE trunk requires scan_layers=False (mixed "
+                             "dense/MoE blocks are heterogeneous; expert "
+                             "params shard over ep, not a layer axis)")
         if self.attention_layers is not None:
             if len(self.attention_layers) != self.num_layers:
                 raise ValueError(
@@ -436,8 +450,30 @@ class Block(nn.Module):
     config: TransformerConfig
     layer_idx: Optional[int] = None
 
+    def _is_moe_layer(self):
+        cfg = self.config
+        return (cfg.moe_num_experts > 0 and self.layer_idx is not None
+                and (self.layer_idx + 1) % cfg.moe_every == 0)
+
+    def _mlp(self, h, train=True):
+        """Dense MLP or MoE for this block; returns (out, aux_loss).
+        ``train`` selects the gate's capacity/noise regime (reference
+        ``TopKGate`` train vs eval capacity)."""
+        cfg = self.config
+        if not self._is_moe_layer():
+            return MLP(cfg, name="mlp")(h), 0.0
+        from deepspeed_tpu.moe.layer import MoE
+        out, aux, _ = MoE(hidden_size=cfg.hidden_size,
+                          num_experts=cfg.moe_num_experts,
+                          ep_size=cfg.moe_ep_size, k=cfg.moe_top_k,
+                          capacity_factor=cfg.moe_capacity_factor,
+                          eval_capacity_factor=cfg.moe_eval_capacity_factor,
+                          ffn_hidden_size=cfg.ffn_size,
+                          dtype=cfg.jnp_dtype, name="moe_mlp")(h, train=train)
+        return out.astype(cfg.jnp_dtype), aux
+
     @nn.compact
-    def __call__(self, x, positions, mask=None, cache=None):
+    def __call__(self, x, positions, mask=None, cache=None, train=True):
         cfg = self.config
         normed = _norm(cfg, "input_norm")(x).astype(cfg.jnp_dtype)
         attn, new_cache = Attention(cfg, layer_idx=self.layer_idx,
@@ -446,21 +482,26 @@ class Block(nn.Module):
         if cfg.parallel_residual:
             mlp_in = normed if cfg.shared_attn_mlp_norm else \
                 _norm(cfg, "post_attn_norm")(x).astype(cfg.jnp_dtype)
-            x = x + attn + MLP(cfg, name="mlp")(mlp_in)
+            mlp_out, aux = self._mlp(mlp_in, train=train)
+            x = x + attn + mlp_out
         else:
             x = x + attn
-            x = x + MLP(cfg, name="mlp")(
-                _norm(cfg, "post_attn_norm")(x).astype(cfg.jnp_dtype))
-        return x, new_cache
+            mlp_out, aux = self._mlp(
+                _norm(cfg, "post_attn_norm")(x).astype(cfg.jnp_dtype),
+                train=train)
+            x = x + mlp_out
+        return x, new_cache, aux
 
 
 class ScanBlock(Block):
     """Block with the (carry, output) signature nn.scan requires: the
-    activation is the carry, per-layer KV caches are scanned xs/ys."""
+    activation is the carry, per-layer KV caches (+ aux losses) are the
+    scanned ys."""
 
     @nn.compact
     def __call__(self, x, positions, mask=None, cache=None):
-        return Block.__call__(self, x, positions, mask, cache)
+        x, new_cache, aux = Block.__call__(self, x, positions, mask, cache)
+        return x, (new_cache, aux)
 
 
 class Transformer(nn.Module):
@@ -500,7 +541,8 @@ class Transformer(nn.Module):
                                     dtype=cfg.jnp_dtype, param_dtype=jnp.float32,
                                     name="lm_head")
 
-    def hidden_states(self, input_ids, mask=None, cache=None, start_pos=0):
+    def hidden_states(self, input_ids, mask=None, cache=None, start_pos=0,
+                      with_aux=False, train=True):
         cfg = self.config
         B, S = input_ids.shape
         positions = start_pos + jnp.broadcast_to(jnp.arange(S), (B, S))
@@ -510,17 +552,21 @@ class Transformer(nn.Module):
         if cfg.embedding_norm:
             x = self.embed_norm(x).astype(cfg.jnp_dtype)
         if cfg.scan_layers:
-            x, new_cache = self.blocks(x, positions, mask, cache)
+            x, (new_cache, aux_layers) = self.blocks(x, positions, mask, cache)
+            aux = jnp.sum(aux_layers)
         else:
-            new_layers = []
+            new_layers, aux = [], 0.0
             for i, blk in enumerate(self.block_list):
                 layer_cache = None if cache is None else \
                     jax.tree.map(lambda c: c[i], cache)
-                x, nc = blk(x, positions, mask, layer_cache)
+                x, nc, a = blk(x, positions, mask, layer_cache, train=train)
                 new_layers.append(nc)
+                aux = aux + a
             new_cache = None if cache is None else \
                 jax.tree.map(lambda *cs: jnp.stack(cs), *new_layers)
         h = self.final_norm(x).astype(cfg.jnp_dtype)
+        if with_aux:
+            return h, new_cache, aux
         return (h, new_cache) if cache is not None else h
 
     def _head(self, x):
@@ -529,14 +575,32 @@ class Transformer(nn.Module):
             return x @ emb.T
         return self.lm_head(x)
 
+    def _head_pure(self, ref):
+        """Pure head closure over concrete weight arrays — safe to call
+        inside ``jax.checkpoint``/``lax.map`` (a bound ``nn.Dense`` is not:
+        flax modules cannot be invoked under raw jax transforms).  ``ref``
+        is any [..., S, h] activation; a zero-width slice through lm_head
+        forces its params to exist at init time with no compute."""
+        cfg = self.config
+        if cfg.tie_word_embeddings:
+            W = self.embed_tokens.embedding.astype(cfg.jnp_dtype).T
+            return lambda x: x @ W
+        self.lm_head(ref[..., :0, :])
+        p = self.lm_head.variables["params"]
+        W = jnp.asarray(p["kernel"], cfg.jnp_dtype)
+        if "bias" in p:
+            b = jnp.asarray(p["bias"], cfg.jnp_dtype)
+            return lambda x: x @ W + b
+        return lambda x: x @ W
+
     def logits(self, input_ids, mask=None):
-        return self._head(self.hidden_states(input_ids, mask))
+        return self._head(self.hidden_states(input_ids, mask, train=False))
 
     def decode(self, input_ids, cache, start_pos):
         """KV-cached decode/prefill step: returns (logits, new_cache).
         ``input_ids``: [B, S_step]; positions are ``start_pos + arange``."""
         h, new_cache = self.hidden_states(input_ids, cache=cache,
-                                          start_pos=start_pos)
+                                          start_pos=start_pos, train=False)
         return self._head(h), new_cache
 
     def init_cache(self, batch_size, max_len, dtype=None):
@@ -558,17 +622,22 @@ class Transformer(nn.Module):
             input_ids, labels, mask = batch, None, None
         if labels is None:
             labels = derive_causal_labels(input_ids, mask)
-        C = self.config.loss_seq_chunks
-        if C > 1:
-            if input_ids.shape[1] % C == 0:
-                h = self.hidden_states(input_ids, mask)
-                return chunked_cross_entropy_loss(h, labels, self._head, C)
+        cfg = self.config
+        C = cfg.loss_seq_chunks
+        if C > 1 and input_ids.shape[1] % C != 0:
             logger.warning(
                 f"loss_seq_chunks={C} does not divide seq_len="
                 f"{input_ids.shape[1]} — falling back to full-logits loss "
                 f"(materializes the [B,S,V] tensor)")
-        logits = self.logits(input_ids, mask)
-        return cross_entropy_loss(logits, labels)
+            C = 0
+        h, _, aux = self.hidden_states(input_ids, mask, with_aux=True)
+        if C > 1:
+            loss = chunked_cross_entropy_loss(h, labels, self._head_pure(h), C)
+        else:
+            loss = cross_entropy_loss(self._head(h), labels)
+        if cfg.moe_num_experts > 0:
+            loss = loss + cfg.moe_aux_coef * aux
+        return loss
 
 
 def derive_causal_labels(input_ids, attention_mask=None, ignore_index=-100):

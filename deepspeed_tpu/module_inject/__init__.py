@@ -6,7 +6,8 @@ sharding annotations (AutoTP rules) instead of per-rank weight surgery.
 """
 
 from deepspeed_tpu.module_inject.replace_module import (  # noqa: F401
-    convert_hf_model, replace_transformer_layer, policy_for)
+    convert_hf_model, load_megatron_model, replace_transformer_layer,
+    policy_for)
 from deepspeed_tpu.module_inject.auto_tp import AutoTP, get_tp_rules  # noqa: F401
 from deepspeed_tpu.module_inject.policy import HFPolicy  # noqa: F401
 from deepspeed_tpu.module_inject.containers import (  # noqa: F401

@@ -454,6 +454,87 @@ class BertPolicy(HFPolicy):
         return flat
 
 
+class MegatronGPTPolicy(HFPolicy):
+    """Megatron-LM GPT checkpoints (reference ``containers/megatron_gpt.py``
+    + ``replace_policy.py`` MegatronLayerPolicy): pre-LN GPT-2 architecture
+    with fused query_key_value, dense_h_to_4h/dense_4h_to_h MLP naming, and
+    two fused-QKV row layouts — Megatron v2 interleaves per head ([H, 3, D]),
+    v1 chunks per projection ([3, H·D]).  Consumes a *merged* state dict (use
+    ``runtime/state_dict_factory.py`` MegatronSDLoader to fold TP shards
+    first); see ``replace_module.load_megatron_model`` for the end-to-end
+    path."""
+
+    model_types = ("megatron-gpt",)
+    PREFIXES = ("model.language_model.", "language_model.", "module.", "")
+
+    @staticmethod
+    def normalize(sd):
+        """Strip megatron wrapper prefixes; unify encoder/transformer."""
+        out = {}
+        for k, v in sd.items():
+            for p in MegatronGPTPolicy.PREFIXES:
+                if p and k.startswith(p):
+                    k = k[len(p):]
+                    break
+            k = k.replace("encoder.layers.", "transformer.layers.")
+            out[k] = v
+        return out
+
+    def build_config(self, hf, **over):
+        # hf here is a plain namespace/dict-like carrying megatron args
+        get = lambda n, d=None: getattr(hf, n, d)
+        base = dict(
+            vocab_size=get("padded_vocab_size") or get("vocab_size"),
+            hidden_size=get("hidden_size"),
+            num_layers=get("num_layers"),
+            num_heads=get("num_attention_heads") or get("num_heads"),
+            ffn_hidden_size=get("ffn_hidden_size") or 4 * get("hidden_size"),
+            max_seq_len=get("max_position_embeddings", 1024),
+            activation="gelu",
+            position_embedding="learned",
+            tie_word_embeddings=True,
+            layernorm_epsilon=get("layernorm_epsilon", 1e-5),
+        )
+        base.update(over)
+        return TransformerConfig(**base)
+
+    def top_params(self, sd, cfg):
+        out = {"embed_tokens/embedding":
+                   _np(sd["embedding.word_embeddings.weight"]
+                       if "embedding.word_embeddings.weight" in sd
+                       else sd["word_embeddings.weight"])[:cfg.vocab_size],
+               "embed_positions/embedding":
+                   _np(sd["embedding.position_embeddings.weight"]
+                       if "embedding.position_embeddings.weight" in sd
+                       else sd["position_embeddings.weight"])}
+        out.update(self.norm(sd, "transformer.final_layernorm", "final_norm"))
+        return out
+
+    def layer_params(self, sd, i, cfg):
+        p = f"transformer.layers.{i}"
+        H, D = cfg.num_heads, cfg.head_dim
+        w = sd[f"{p}.attention.query_key_value.weight"]
+        b = sd.get(f"{p}.attention.query_key_value.bias")
+        if getattr(self, "megatron_v2", True):
+            out = split_fused_qkv_headwise(w, H, D, bias=b)
+        else:
+            out = split_fused_qkv_columns(_np(w).T, H, D,
+                                          bias=None if b is None else _np(b))
+        out["attn/o_proj/kernel"] = o_kernel(
+            sd[f"{p}.attention.dense.weight"], H, D)
+        out["attn/o_proj/bias"] = _np(sd[f"{p}.attention.dense.bias"])
+        out.update(self.norm(sd, f"{p}.input_layernorm", "input_norm"))
+        out.update(self.norm(sd, f"{p}.post_attention_layernorm",
+                             "post_attn_norm"))
+        out["mlp/up_proj/kernel"] = linear_kernel(
+            sd[f"{p}.mlp.dense_h_to_4h.weight"])
+        out["mlp/up_proj/bias"] = _np(sd[f"{p}.mlp.dense_h_to_4h.bias"])
+        out["mlp/down_proj/kernel"] = linear_kernel(
+            sd[f"{p}.mlp.dense_4h_to_h.weight"])
+        out["mlp/down_proj/bias"] = _np(sd[f"{p}.mlp.dense_4h_to_h.bias"])
+        return out
+
+
 class DistilBertPolicy(BertPolicy):
     """distilbert-* (reference ``containers/distil_bert.py``): BERT encoder
     minus token-type embeddings; MLM head named vocab_transform /
@@ -537,4 +618,4 @@ class DistilBertPolicy(BertPolicy):
 
 ALL_POLICIES = [OPTPolicy, GPT2Policy, LlamaPolicy, BloomPolicy,
                 GPTNeoXPolicy, GPTJPolicy, GPTNeoPolicy, BertPolicy,
-                DistilBertPolicy]
+                DistilBertPolicy, MegatronGPTPolicy]

@@ -35,8 +35,10 @@ ACT_MAP = {
 
 
 def _np(t):
-    """torch tensor → float32 numpy (host)."""
-    return t.detach().cpu().float().numpy()
+    """torch tensor (or array) → float32 numpy (host)."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, dtype=np.float32)
 
 
 def linear_kernel(w):

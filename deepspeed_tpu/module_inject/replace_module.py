@@ -15,6 +15,8 @@ TP sharding afterwards is a sharding annotation over the converted names
 by GSPMD — no per-rank weight surgery.
 """
 
+import re
+
 import numpy as np
 
 import jax
@@ -90,6 +92,60 @@ def convert_hf_model(model_or_name, param_dtype=None, **config_overrides):
     logger.info(f"converted {hf_config.model_type} model: "
                 f"{len(consumed_hint)} HF tensors → {len(flat)} flax tensors, "
                 f"{cfg.num_layers}L/{cfg.hidden_size}H")
+    model = policy.build_model(cfg)
+    params = _materialize(model, flat, param_dtype=param_dtype)
+    return model, params
+
+
+def load_megatron_model(checkpoint, num_heads=None, megatron_v2=True,
+                        param_dtype=None, **config_overrides):
+    """Megatron-LM GPT checkpoint → (flax Transformer, params).
+
+    ``checkpoint``: a DeepSpeed checkpoint-description json (path or dict,
+    reference ``SDLoaderFactory.get_sd_loader_json``), a list of TP shard
+    files, or an already-merged flat state dict.  TP shards are folded by
+    ``MegatronSDLoader.merge_state_dict``; model dims are inferred from the
+    merged tensors (heads can't be — pass ``num_heads``)."""
+    import numpy as np
+    from deepspeed_tpu.module_inject.containers import MegatronGPTPolicy
+    from deepspeed_tpu.runtime.state_dict_factory import (get_sd_loader,
+                                                          get_sd_loader_json)
+
+    if isinstance(checkpoint, dict) and "checkpoints" not in checkpoint \
+            and not isinstance(next(iter(checkpoint.values()), None), str):
+        sd = checkpoint                       # already-merged state dict
+    else:
+        if isinstance(checkpoint, (str, dict)):
+            _, ckpt_list, version = get_sd_loader_json(checkpoint)
+        else:
+            ckpt_list, version = list(checkpoint), None
+        if not version:               # merge must know the fused-QKV layout
+            version = 2.0 if megatron_v2 else 1.0
+        sd = get_sd_loader(ckpt_list, version=version).merge_state_dict()
+
+    sd = MegatronGPTPolicy.normalize(sd)
+    emb_key = "embedding.word_embeddings.weight" \
+        if "embedding.word_embeddings.weight" in sd else "word_embeddings.weight"
+    pos_key = emb_key.replace("word", "position")
+    layer_ids = {int(m.group(1)) for k in sd
+                 if (m := re.match(r"transformer\.layers\.(\d+)\.", k))}
+    h4h = sd[f"transformer.layers.0.mlp.dense_h_to_4h.weight"]
+
+    class _Args:                              # megatron arg namespace
+        vocab_size = np.asarray(sd[emb_key]).shape[0]
+        hidden_size = np.asarray(sd[emb_key]).shape[1]
+        num_layers = max(layer_ids) + 1
+        num_attention_heads = num_heads
+        ffn_hidden_size = np.asarray(h4h).shape[0]
+        max_position_embeddings = np.asarray(sd[pos_key]).shape[0]
+
+    if num_heads is None:
+        raise ValueError("num_heads is not recoverable from a megatron "
+                         "state dict — pass num_heads=")
+    policy = MegatronGPTPolicy()
+    policy.megatron_v2 = megatron_v2
+    cfg = policy.build_config(_Args(), **config_overrides)
+    flat = policy.convert(sd, cfg)
     model = policy.build_model(cfg)
     params = _materialize(model, flat, param_dtype=param_dtype)
     return model, params

@@ -120,13 +120,22 @@ class MegatronSDLoader(SDLoaderBase):
         for name, first in shards[0].items():
             parts = [s[name] for s in shards]
             kind = _classify(name)
-            if first.ndim == 0 or kind == "replicated" or \
-                    all((p == parts[0]).all() for p in parts[1:]):
+            if re.search(r"(^|[._/])(query_key_value|qkv)([._/]|$)", name):
+                # fused QKV needs version-aware merging (reference
+                # ``merge_query_key_value``): v1 shards are internally
+                # [q_r|k_r|v_r], so naive concat would interleave per-rank
+                # q/k/v blocks.  Megatron v2 interleaves per head — plain
+                # concat on the output axis is correct there.
+                merged[name] = self._merge_qkv(parts, name)
+            elif first.ndim == 0 or kind == "replicated":
                 merged[name] = parts[0]
             elif first.ndim == 1:
                 # column-parallel bias shards concatenate; row-parallel
-                # biases are replicated (handled above by equality)
-                merged[name] = np.concatenate(parts, axis=0)
+                # biases are replicated across ranks.  Decide by kind, not
+                # by value equality — zero-initialized column biases must
+                # still concatenate.
+                merged[name] = np.concatenate(parts, axis=0) \
+                    if kind == "column" else parts[0]
             elif kind == "column":
                 # torch Linear weight [out, in] → concat outputs on axis 0;
                 # flax kernels [in, out] → axis -1.  Heuristic: torch layout
@@ -138,12 +147,34 @@ class MegatronSDLoader(SDLoaderBase):
                 merged[name] = np.concatenate(parts, axis=axis)
         return merged
 
+    def _merge_qkv(self, parts, name):
+        """Merge fused query_key_value shards (output axis 0 in torch
+        layout).  ``version >= 2`` (or unset) → head-interleaved rows, plain
+        concat.  ``version < 2`` → each shard is [q_r|k_r|v_r]: split every
+        shard into thirds and concatenate per projection."""
+        axis = 0
+        if self.version is None or float(self.version) >= 2.0:
+            return np.concatenate(parts, axis=axis)
+        thirds = [np.split(p, 3, axis=axis) for p in parts]
+        return np.concatenate(
+            [np.concatenate([t[j] for t in thirds], axis=axis)
+             for j in range(3)], axis=axis)
+
     def split_state_dict(self, mp_world_size, mp_rank, quantize=False, **kw):
         """Full state dict → this rank's TP shard (TP degree 1 → n)."""
         full = self.merge_state_dict()
         out = {}
         for name, w in full.items():
             kind = _classify(name)
+            if re.search(r"(^|[._/])(query_key_value|qkv)([._/]|$)", name) \
+                    and (self.version is not None
+                         and float(self.version) < 2.0):
+                # v1 fused QKV: rank r takes [q_r|k_r|v_r]
+                q, k, v = np.split(w, 3, axis=0)
+                out[name] = np.concatenate(
+                    [np.split(t, mp_world_size, axis=0)[mp_rank]
+                     for t in (q, k, v)], axis=0)
+                continue
             if w.ndim == 0 or kind == "replicated":
                 out[name] = w
                 continue

@@ -97,3 +97,40 @@ def test_barrier(topo):
 def test_eager_all_reduce_single_process(topo):
     out = dist.all_reduce(jnp.ones((4,)))
     np.testing.assert_allclose(np.asarray(out), np.ones(4))
+
+
+def test_gather_and_list_all_gather(topo):
+    """gather/all_gather (list style): shards stacked on a leading axis."""
+    x = jnp.arange(8.0)
+    want = np.arange(8.0).reshape(8, 1)
+    out = _run_collective(
+        topo, lambda v: dist.gather(v, group=DP_AXES), x,
+        P(DP_AXES), P(None, None))
+    np.testing.assert_allclose(np.asarray(out), want)
+    out2 = _run_collective(
+        topo, lambda v: dist.all_gather(v, group=DP_AXES), x,
+        P(DP_AXES), P(None, None))
+    np.testing.assert_allclose(np.asarray(out2), want)
+
+
+def test_scatter(topo):
+    """scatter: participant i takes slice i of the (replicated) source."""
+    src = jnp.arange(8.0 * 3).reshape(8, 3)
+    out = _run_collective(
+        topo, lambda v: dist.scatter(v, group=DP_AXES), src,
+        P(None, None), P(DP_AXES))
+    np.testing.assert_allclose(np.asarray(out).reshape(8, 3), np.asarray(src))
+
+
+def test_monitored_barrier_and_inference_all_reduce(topo):
+    dist.monitored_barrier()
+    x = jnp.ones(8)
+    out = _run_collective(
+        topo, lambda v: dist.inference_all_reduce(v, group=DP_AXES), x,
+        P(DP_AXES), P(DP_AXES))
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 8.0))
+
+
+def test_isend_raises_with_guidance():
+    with pytest.raises(NotImplementedError):
+        dist.isend(jnp.ones(4), dst=1)

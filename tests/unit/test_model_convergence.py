@@ -1,0 +1,53 @@
+"""End-to-end model convergence — the analog of reference
+``tests/model/Megatron_GPT2/run_sanity_check.py``: train a real (tiny)
+decoder-only LM on a learnable synthetic task and demand the loss actually
+converges, not merely ticks down.  Runs the full production path: Transformer
+trunk + flash-attention fallbacks + fused engine step + ZeRO sharding on the
+8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import Transformer, TransformerConfig
+
+
+VOCAB = 64
+
+
+def copy_task_batch(rng, bs=8, seq=32):
+    """Next-token-predictable stream: the second half of every row repeats
+    the first half, so a 2-layer model can drive loss well below the
+    uniform-baseline ln(VOCAB)≈4.16 by learning to copy."""
+    half = rng.integers(2, VOCAB, (bs, seq // 2)).astype(np.int32)
+    ids = np.concatenate([half, half], axis=1)
+    return {"input_ids": ids}
+
+
+@pytest.mark.parametrize("stage", [1, 3])
+def test_tiny_lm_converges(stage):
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, hidden_size=64, num_layers=2, num_heads=4,
+        max_seq_len=32, dtype="float32", use_flash_attention=False,
+        remat=False, scan_layers=True)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=Transformer(cfg),
+        config={"train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+                "zero_optimization": {"stage": stage},
+                "gradient_clipping": 1.0})
+    rng = np.random.default_rng(0)
+    first = None
+    for step in range(150):
+        loss = engine(copy_task_batch(rng))
+        engine.backward(loss)
+        engine.step()
+        if first is None:
+            first = float(jax.device_get(loss))
+    last = float(jax.device_get(loss))
+    # copying the second half is learnable: demand real convergence, far
+    # beyond "decreased" (uniform baseline ~4.16, start ~ln V)
+    assert last < 0.6 * first, (first, last)
+    assert last < 2.5, f"did not learn the copy task: {last}"

@@ -174,3 +174,20 @@ def test_chunked_cross_entropy_matches_full():
     for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gc)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_chunked_loss_untied_head_matches_full():
+    """loss_seq_chunks with an untied lm_head must trace (pure-closure head
+    inside jax.checkpoint/lax.map) and match the full-logits loss."""
+    from deepspeed_tpu.models.transformer import Transformer, TransformerConfig
+    import jax, numpy as np, jax.numpy as jnp
+    kw = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+              max_seq_len=16, dtype="float32", use_flash_attention=False,
+              remat=False, tie_word_embeddings=False)
+    m_full = Transformer(TransformerConfig(**kw))
+    m_chunk = Transformer(TransformerConfig(**kw, loss_seq_chunks=4))
+    ids = np.random.default_rng(0).integers(0, 64, (2, 16)).astype(np.int32)
+    params = jax.jit(m_full.init)(jax.random.key(0), {"input_ids": ids})
+    l_full = float(m_full.apply(params, {"input_ids": ids}))
+    l_chunk = float(m_chunk.apply(params, {"input_ids": ids}))
+    np.testing.assert_allclose(l_chunk, l_full, rtol=1e-5)

@@ -164,3 +164,77 @@ def test_policy_for_unknown_raises():
         model_type = "some_unknown_arch"
     with pytest.raises(NotImplementedError):
         policy_for(FakeCfg())
+
+
+def test_megatron_checkpoint_loads_with_tp_merge(tmp_path):
+    """Megatron-GPT container (reference ``containers/megatron_gpt.py``):
+    a GPT-2 computation re-emitted as Megatron-v2 TP shards (fused
+    query_key_value in [H,3,D] row order, dense_h_to_4h naming) must merge
+    through MegatronSDLoader and reproduce the HF logits exactly."""
+    from deepspeed_tpu.module_inject import load_megatron_model
+
+    t = TINY
+    hf = tiny_hf_model("gpt2")
+    sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+    H, D = t["heads"], t["hidden"] // t["heads"]
+    L = t["layers"]
+
+    def v2_qkv(w_in_3h):                       # [in, 3h] → megatron [3h, in]
+        w = w_in_3h.T                          # [3h, in], rows [3, H, D]
+        return np.ascontiguousarray(
+            w.reshape(3, H, D, -1).transpose(1, 0, 2, 3).reshape(3 * H * D, -1))
+
+    def v2_qkv_bias(b):
+        return np.ascontiguousarray(
+            b.reshape(3, H, D).transpose(1, 0, 2).reshape(-1))
+
+    meg = {"word_embeddings.weight": sd["transformer.wte.weight"],
+           "position_embeddings.weight": sd["transformer.wpe.weight"],
+           "transformer.final_layernorm.weight": sd["transformer.ln_f.weight"],
+           "transformer.final_layernorm.bias": sd["transformer.ln_f.bias"]}
+    for i in range(L):
+        src, dst = f"transformer.h.{i}", f"transformer.layers.{i}"
+        meg[f"{dst}.input_layernorm.weight"] = sd[f"{src}.ln_1.weight"]
+        meg[f"{dst}.input_layernorm.bias"] = sd[f"{src}.ln_1.bias"]
+        meg[f"{dst}.attention.query_key_value.weight"] = \
+            v2_qkv(sd[f"{src}.attn.c_attn.weight"])
+        meg[f"{dst}.attention.query_key_value.bias"] = \
+            v2_qkv_bias(sd[f"{src}.attn.c_attn.bias"])
+        meg[f"{dst}.attention.dense.weight"] = sd[f"{src}.attn.c_proj.weight"].T
+        meg[f"{dst}.attention.dense.bias"] = sd[f"{src}.attn.c_proj.bias"]
+        meg[f"{dst}.post_attention_layernorm.weight"] = sd[f"{src}.ln_2.weight"]
+        meg[f"{dst}.post_attention_layernorm.bias"] = sd[f"{src}.ln_2.bias"]
+        meg[f"{dst}.mlp.dense_h_to_4h.weight"] = sd[f"{src}.mlp.c_fc.weight"].T
+        meg[f"{dst}.mlp.dense_h_to_4h.bias"] = sd[f"{src}.mlp.c_fc.bias"]
+        meg[f"{dst}.mlp.dense_4h_to_h.weight"] = sd[f"{src}.mlp.c_proj.weight"].T
+        meg[f"{dst}.mlp.dense_4h_to_h.bias"] = sd[f"{src}.mlp.c_proj.bias"]
+
+    # split into 2 Megatron TP shards: column-parallel → out dim (axis 0),
+    # row-parallel → in dim (axis 1); embeddings/norm/row-bias replicated
+    from deepspeed_tpu.runtime.state_dict_factory import _classify
+    shards = [{}, {}]
+    for name, w in meg.items():
+        kind = _classify(name)
+        if kind == "column":
+            axis = 0 if name.endswith("weight") else 0
+            parts = np.split(w, 2, axis=axis)
+        elif kind == "row" and name.endswith("weight"):
+            parts = np.split(w, 2, axis=1)
+        else:
+            parts = [w, w]
+        for r in range(2):
+            shards[r][name] = parts[r]
+    paths = []
+    for r in range(2):
+        p = tmp_path / f"mp_rank_{r:02d}_model_states.npz"
+        np.savez(p, **shards[r])
+        paths.append(str(p))
+
+    model, params = load_megatron_model(paths, num_heads=H,
+                                        dtype="float32",
+                                        use_flash_attention=False)
+    ids = np.random.default_rng(7).integers(0, t["vocab"],
+                                            (2, 16)).astype(np.int32)
+    got = np.asarray(jax.jit(
+        lambda p, i: model.apply(p, i, method=type(model).logits))(params, ids))
+    np.testing.assert_allclose(got, hf_logits(hf, ids), atol=1e-4, rtol=1e-4)

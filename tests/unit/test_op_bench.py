@@ -1,0 +1,22 @@
+"""Smoke tests for the op micro-benchmark CLI (analog of reference
+``tests/perf/adam_test.py`` — correctness of the harness, not speed)."""
+
+from deepspeed_tpu.benchmarks import op_bench
+
+
+def test_bench_adam_smoke():
+    r = op_bench.bench_adam(numel=2048, iters=1)
+    assert r["op"] == "fused_adamw" and r["ms"] > 0
+
+
+def test_bench_flash_smoke():
+    r = op_bench.bench_flash_attention(b=1, s=256, h=2, d=64, iters=1)
+    assert r["TFLOP/s"] > 0
+    r = op_bench.bench_flash_attention(b=1, s=256, h=2, d=64, iters=1,
+                                       bwd=True)
+    assert r["op"].endswith("bwd")
+
+
+def test_bench_quant_smoke():
+    r = op_bench.bench_quantizer(numel=64 * 2048, iters=1)
+    assert r["ms"] > 0

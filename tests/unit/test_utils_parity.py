@@ -214,3 +214,36 @@ def test_elastic_agent_config_resize():
     for cfg, n in ((cfg4, 4), (cfg8, 8)):
         assert cfg["train_micro_batch_size_per_gpu"] * \
             cfg["gradient_accumulation_steps"] * n == cfg["train_batch_size"]
+
+def test_megatron_v1_qkv_split_merge_roundtrip(tmp_path):
+    """Version-aware fused-QKV shard handling (reference
+    ``merge_query_key_value``): v1 shards are [q_r|k_r|v_r]; naive concat
+    would interleave per-rank blocks."""
+    import numpy as np
+    from deepspeed_tpu.runtime.state_dict_factory import MegatronSDLoader
+
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((12, 4)).astype(np.float32)   # [3h=12, in]
+    b = rng.standard_normal((12,)).astype(np.float32)
+    full = {"transformer.layers.0.attention.query_key_value.weight": w,
+            "transformer.layers.0.attention.query_key_value.bias": b}
+    p0 = tmp_path / "full.npz"
+    np.savez(p0, **full)
+
+    loader = MegatronSDLoader([str(p0)], version=1.0)
+    shard_paths = []
+    for r in range(2):
+        shard = loader.split_state_dict(2, r)
+        # v1 rank shard really is [q_r|k_r|v_r]
+        np.testing.assert_array_equal(
+            shard["transformer.layers.0.attention.query_key_value.weight"],
+            np.concatenate([np.split(t, 2)[r] for t in np.split(w, 3)]))
+        p = tmp_path / f"rank{r}.npz"
+        np.savez(p, **shard)
+        shard_paths.append(str(p))
+
+    merged = MegatronSDLoader(shard_paths, version=1.0).merge_state_dict()
+    np.testing.assert_array_equal(
+        merged["transformer.layers.0.attention.query_key_value.weight"], w)
+    np.testing.assert_array_equal(
+        merged["transformer.layers.0.attention.query_key_value.bias"], b)

@@ -1,0 +1,72 @@
+"""Long-context benchmark: sequence-parallel attention scaling.
+
+The reference's long-context story is block-sparse attention (no SP/CP in
+v0.9.3); this framework additionally ships Ulysses-style all-to-all and ring
+attention over an ``sp`` mesh axis (``parallel/sequence.py``).  This CLI
+sweeps sequence lengths through ring/ulysses attention on the live mesh and
+prints one JSON line per point: per-chip attention time + effective TFLOP/s.
+
+On a laptop/CI run it uses the 8-device virtual CPU mesh; on a pod slice the
+same code rides ICI.
+"""
+
+import argparse
+import json
+
+import numpy as np
+
+
+def bench_sp_attention(impl, seq, heads=16, head_dim=64, batch=1, iters=5):
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.parallel.topology import get_topology
+    from deepspeed_tpu.parallel.sequence import shard_map_attention
+
+    topo = get_topology()
+    sp = topo.get_sequence_parallel_world_size()
+    fn = jax.jit(shard_map_attention(topo.mesh, impl=impl, axis="sp",
+                                     causal=True))
+    rng = np.random.default_rng(0)
+    # bf16 is MXU-native on TPU but *emulated* (slow) on CPU meshes
+    dtype = jnp.bfloat16 if jax.devices()[0].platform == "tpu" \
+        else jnp.float32
+    q, k, v = (jnp.asarray(rng.standard_normal((batch, seq, heads, head_dim)),
+                           dtype) for _ in range(3))
+    from deepspeed_tpu.benchmarks.op_bench import _timeit
+    dt = _timeit(lambda *a: fn(*a), (q, k, v), iters)
+    flops = 2 * 2 * batch * heads * seq * seq * head_dim / 2   # causal
+    return {"impl": impl, "seq": seq, "sp": sp,
+            "ms": round(dt * 1e3, 2),
+            "TFLOP/s/chip": round(flops / dt / 1e12 / max(sp, 1), 3)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--impls", default="ring,ulysses")
+    ap.add_argument("--seqs", default="8192,16384,32768")
+    ap.add_argument("--sp", type=int, default=None,
+                    help="sp axis size (default: all devices)")
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+
+    import jax
+    from deepspeed_tpu.parallel.topology import (get_topology,
+                                                 initialize_topology)
+    # a default dp-only topology may already be live from import — the
+    # sweep needs the sp axis, so (re)initialize explicitly
+    topo = get_topology()
+    if topo is None or topo.get_sequence_parallel_world_size() <= 1:
+        initialize_topology(sp=args.sp or jax.device_count())
+
+    for impl in args.impls.split(","):
+        for seq in (int(s) for s in args.seqs.split(",")):
+            try:
+                print(json.dumps(bench_sp_attention(impl.strip(), seq,
+                                                    iters=args.iters)))
+            except Exception as e:
+                print(json.dumps({"impl": impl, "seq": seq,
+                                  "error": str(e)[:200]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -3,7 +3,7 @@
 TPU-native re-design of the reference dispatch module
 (``deepspeed/comm/comm.py:214-562``).  The verb set is preserved —
 ``all_reduce``, ``all_gather_into_tensor``, ``reduce_scatter_tensor``,
-``all_to_all_single``, ``send``/``recv`` (→ ``ppermute``), ``broadcast``,
+``all_to_all_single``, ``ppermute``/``send_recv_next`` (the p2p analog), ``broadcast``,
 ``barrier`` — but groups are mesh axis names, not NCCL communicators, and the
 hot path runs *inside* jitted/shard_mapped programs where XLA schedules the
 collectives onto ICI.
@@ -353,11 +353,15 @@ def scatter(tensor, scatter_list=None, src=0, group=None, log_name=None):
 
 
 def isend(tensor, dst, group=None, tag=0):
-    """Async point-to-point (reference ``comm.py:420``).  TPU p2p is a
-    compiled ``ppermute``; the 'async' handle is the value itself (XLA
-    overlaps it) — pair with :func:`ppermute` for real stage transfer."""
+    """Point-to-point verbs (reference ``comm.py:420`` isend/irecv,
+    ``:428`` send/recv) are NOT supported as standalone eager ops on TPU —
+    this always raises with guidance.  Rank-addressed p2p has no XLA analog
+    outside a compiled collective: use :func:`ppermute` /
+    :func:`send_recv_next` / :func:`send_recv_prev` inside ``shard_map``
+    (both halves of each exchange are one collective-permute riding ICI,
+    which is how the pipeline engine moves activations)."""
     raise NotImplementedError(
-        "isend/irecv have no eager analog on TPU: use ppermute / "
+        "isend/irecv/send/recv have no eager analog on TPU: use ppermute / "
         "send_recv_next inside shard_map (pipeline p2p rides ICI)")
 
 

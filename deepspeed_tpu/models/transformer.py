@@ -522,7 +522,12 @@ class Transformer(nn.Module):
         block = ScanBlock if cfg.scan_layers else Block
         if cfg.remat:
             policy = resolve_remat_policy(cfg.remat_policy)
-            block = nn.remat(block, policy=policy, static_argnums=())
+            # non-scan Block takes `train` as positional arg 5 (counting
+            # self) — it gates Python control flow in the MoE gate and must
+            # stay a static bool through jax.checkpoint (ScanBlock has no
+            # train arg; kwargs are not covered by static_argnums)
+            static = () if cfg.scan_layers else (5,)
+            block = nn.remat(block, policy=policy, static_argnums=static)
         if cfg.scan_layers:
             self.blocks = nn.scan(
                 block,
@@ -559,7 +564,8 @@ class Transformer(nn.Module):
             for i, blk in enumerate(self.block_list):
                 layer_cache = None if cache is None else \
                     jax.tree.map(lambda c: c[i], cache)
-                x, nc, a = blk(x, positions, mask, layer_cache, train=train)
+                # train positional: static_argnums only covers positionals
+                x, nc, a = blk(x, positions, mask, layer_cache, train)
                 new_layers.append(nc)
                 aux = aux + a
             new_cache = None if cache is None else \

@@ -38,6 +38,11 @@ ROW_PARALLEL_PATTERNS = (
     r"attention\.dense", r"attn\.dense", r"wo",
 )
 
+# fused QKV weights need version-aware merge/split (see _merge_qkv)
+FUSED_QKV_RE = re.compile(r"(^|[._/])(query_key_value|qkv)([._/]|$)")
+# megatron VocabParallelEmbedding shards the vocab dim; positions replicate
+VOCAB_EMBED_RE = re.compile(r"word_embeddings\.weight$")
+
 
 def get_sd_loader_json(json_file_or_dict):
     """Parse a DeepSpeed checkpoint description json (reference
@@ -120,13 +125,19 @@ class MegatronSDLoader(SDLoaderBase):
         for name, first in shards[0].items():
             parts = [s[name] for s in shards]
             kind = _classify(name)
-            if re.search(r"(^|[._/])(query_key_value|qkv)([._/]|$)", name):
+            if FUSED_QKV_RE.search(name):
                 # fused QKV needs version-aware merging (reference
                 # ``merge_query_key_value``): v1 shards are internally
                 # [q_r|k_r|v_r], so naive concat would interleave per-rank
                 # q/k/v blocks.  Megatron v2 interleaves per head — plain
                 # concat on the output axis is correct there.
                 merged[name] = self._merge_qkv(parts, name)
+            elif VOCAB_EMBED_RE.search(name) and first.ndim == 2 \
+                    and not all((p == parts[0]).all() for p in parts[1:]):
+                # megatron VocabParallelEmbedding: shards differ → the vocab
+                # dim is TP-sharded, concatenate it.  (Equal shards mean a
+                # replicated embedding — inference-export checkpoints.)
+                merged[name] = np.concatenate(parts, axis=0)
             elif first.ndim == 0 or kind == "replicated":
                 merged[name] = parts[0]
             elif first.ndim == 1:
@@ -166,7 +177,7 @@ class MegatronSDLoader(SDLoaderBase):
         out = {}
         for name, w in full.items():
             kind = _classify(name)
-            if re.search(r"(^|[._/])(query_key_value|qkv)([._/]|$)", name) \
+            if FUSED_QKV_RE.search(name) \
                     and (self.version is not None
                          and float(self.version) < 2.0):
                 # v1 fused QKV: rank r takes [q_r|k_r|v_r]

@@ -129,8 +129,9 @@ def test_moe_transformer_trunk_trains():
     cfg = TransformerConfig(
         vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
         max_seq_len=16, dtype="float32", use_flash_attention=False,
-        remat=False, scan_layers=False, moe_num_experts=4, moe_every=2,
-        moe_ep_size=4, moe_capacity_factor=2.0)
+        remat=True, scan_layers=False, moe_num_experts=4, moe_every=2,
+        moe_ep_size=4, moe_capacity_factor=2.0)   # remat on: the train
+    # bool must stay static through jax.checkpoint (static_argnums)
     engine, *_ = deepspeed_tpu.initialize(
         model=Transformer(cfg),
         config={"train_micro_batch_size_per_gpu": 4,

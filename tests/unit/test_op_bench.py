@@ -11,7 +11,7 @@ def test_bench_adam_smoke():
 
 def test_bench_flash_smoke():
     r = op_bench.bench_flash_attention(b=1, s=256, h=2, d=64, iters=1)
-    assert r["TFLOP/s"] > 0
+    assert r["ms"] > 0 and "TFLOP/s" in r   # rate rounds to 0 on slow CPU
     r = op_bench.bench_flash_attention(b=1, s=256, h=2, d=64, iters=1,
                                        bwd=True)
     assert r["op"].endswith("bwd")
@@ -20,3 +20,16 @@ def test_bench_flash_smoke():
 def test_bench_quant_smoke():
     r = op_bench.bench_quantizer(numel=64 * 2048, iters=1)
     assert r["ms"] > 0
+
+
+def test_long_context_bench_smoke():
+    from deepspeed_tpu.benchmarks.long_context_bench import bench_sp_attention
+    from deepspeed_tpu.parallel.topology import (initialize_topology,
+                                                 reset_topology)
+    reset_topology()
+    initialize_topology(sp=8)
+    try:
+        r = bench_sp_attention("ring", 512, heads=4, head_dim=16, iters=1)
+        assert r["sp"] == 8 and r["ms"] > 0
+    finally:
+        reset_topology()

@@ -247,3 +247,23 @@ def test_megatron_v1_qkv_split_merge_roundtrip(tmp_path):
         merged["transformer.layers.0.attention.query_key_value.weight"], w)
     np.testing.assert_array_equal(
         merged["transformer.layers.0.attention.query_key_value.bias"], b)
+
+
+def test_megatron_vocab_parallel_embedding_merge(tmp_path):
+    """VocabParallelEmbedding shards (differing across ranks) concatenate on
+    the vocab dim; replicated embeddings pass through."""
+    import numpy as np
+    from deepspeed_tpu.runtime.state_dict_factory import MegatronSDLoader
+
+    rng = np.random.default_rng(1)
+    emb = rng.standard_normal((8, 4)).astype(np.float32)
+    pos = rng.standard_normal((6, 4)).astype(np.float32)
+    paths = []
+    for r in range(2):
+        p = tmp_path / f"r{r}.npz"
+        np.savez(p, **{"word_embeddings.weight": np.split(emb, 2)[r],
+                       "position_embeddings.weight": pos})
+        paths.append(str(p))
+    merged = MegatronSDLoader(paths, version=2.0).merge_state_dict()
+    np.testing.assert_array_equal(merged["word_embeddings.weight"], emb)
+    np.testing.assert_array_equal(merged["position_embeddings.weight"], pos)

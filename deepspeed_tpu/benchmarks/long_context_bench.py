@@ -53,10 +53,12 @@ def main():
     from deepspeed_tpu.parallel.topology import (get_topology,
                                                  initialize_topology)
     # a default dp-only topology may already be live from import — the
-    # sweep needs the sp axis, so (re)initialize explicitly
-    topo = get_topology()
-    if topo is None or topo.get_sequence_parallel_world_size() <= 1:
-        initialize_topology(sp=args.sp or jax.device_count())
+    # sweep needs the sp axis.  An explicit --sp always wins; otherwise
+    # re-initialize only when no sp axis is live yet.
+    if args.sp:
+        initialize_topology(sp=args.sp)
+    elif get_topology().get_sequence_parallel_world_size() <= 1:
+        initialize_topology(sp=jax.device_count())
 
     for impl in args.impls.split(","):
         for seq in (int(s) for s in args.seqs.split(",")):

@@ -2,9 +2,12 @@
 (``adam_test.py`` op-speed measurement) plus kernel throughput for the Pallas
 hot paths.  Run as a CLI; prints one JSON line per op.
 
-Timing protocol mirrors ``bench.py``: through the axon tunnel
-``block_until_ready`` can return early, so every measurement closes with a
-dependent ``device_get`` of a scalar derived from the op's output.
+Timing protocol: the axon tunnel adds ~3ms per dispatch and
+``block_until_ready`` can return early, so (a) every measurement closes with
+a dependent ``device_get`` of a scalar derived from the output, and (b) the
+op is iterated *inside* one compiled ``lax.fori_loop`` with a data
+dependence between iterations — one dispatch amortizes the tunnel latency
+across all iters and XLA cannot elide or overlap the chain.
 """
 
 import argparse
@@ -17,10 +20,13 @@ import numpy as np
 def _sync_scalar(x):
     import jax
     import jax.numpy as jnp
-    return float(jax.device_get(jnp.sum(jax.tree.leaves(x)[0][..., :1])))
+    leaf = jax.tree.leaves(x)[0]
+    return float(jax.device_get(jnp.sum(leaf[..., :1])))
 
 
 def _timeit(fn, args, iters):
+    """Wall-clock per call with warm-up + dependent sync (multi-dispatch —
+    includes per-call tunnel latency; used where chaining is impossible)."""
     out = fn(*args)          # compile
     _sync_scalar(out)
     t0 = time.perf_counter()
@@ -30,9 +36,27 @@ def _timeit(fn, args, iters):
     return (time.perf_counter() - t0) / iters
 
 
-def bench_adam(numel=50_000_000, iters=10):
-    """Fused Adam update throughput (reference tests/perf/adam_test.py)."""
+def _timeit_chained(step, init, iters):
+    """Time ``step`` (a pytree→same-shape-pytree function) applied ``iters``
+    times inside one jitted ``fori_loop`` — one dispatch total."""
     import jax
+    from jax import lax
+
+    @jax.jit
+    def loop(x0):
+        return lax.fori_loop(0, iters, lambda i, x: step(x), x0)
+
+    out = loop(init)         # compile + warm
+    _sync_scalar(out)
+    t0 = time.perf_counter()
+    out = loop(init)
+    _sync_scalar(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_adam(numel=50_000_000, iters=20):
+    """Fused Adam update throughput (reference tests/perf/adam_test.py).
+    The (params, state) chain is the natural data dependence."""
     import jax.numpy as jnp
     from deepspeed_tpu.ops.adam.fused_adam import FusedAdamW
 
@@ -40,15 +64,20 @@ def bench_adam(numel=50_000_000, iters=10):
     params = {"w": jnp.ones((numel,), jnp.float32)}
     grads = {"w": jnp.full((numel,), 1e-3, jnp.float32)}
     state = opt.init(params)
-    step = jax.jit(lambda g, s, p: opt.update(g, s, p, step=1))
-    dt = _timeit(step, (grads, state, params), iters)
+
+    def step(carry):
+        p, s = carry
+        new_p, new_s = opt.update(grads, s, p, step=1)
+        return (new_p, new_s)
+
+    dt = _timeit_chained(step, (params, state), iters)
     # adam reads p,g,m,v and writes p,m,v: 7 fp32 streams
     gbps = 7 * numel * 4 / dt / 1e9
     return {"op": "fused_adamw", "numel": numel, "ms": round(dt * 1e3, 3),
             "effective_GB/s": round(gbps, 1)}
 
 
-def bench_flash_attention(b=4, s=2048, h=16, d=64, iters=10, bwd=False):
+def bench_flash_attention(b=4, s=2048, h=16, d=64, iters=20, bwd=False):
     import jax
     import jax.numpy as jnp
     from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
@@ -57,11 +86,24 @@ def bench_flash_attention(b=4, s=2048, h=16, d=64, iters=10, bwd=False):
     q, k, v = (jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
                for _ in range(3))
     if bwd:
-        f = jax.jit(jax.grad(lambda q, k, v: flash_attention(
-            q, k, v, causal=True).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+        grad_fn = jax.grad(lambda q, k, v: flash_attention(
+            q, k, v, causal=True).astype(jnp.float32).sum(), argnums=(0, 1, 2))
+
+        def step(carry):
+            qq, kk, vv = carry
+            dq, dk, dv = grad_fn(qq, kk, vv)
+            # feed grads back in as next inputs: full data dependence
+            return (dq.astype(jnp.bfloat16), dk.astype(jnp.bfloat16),
+                    dv.astype(jnp.bfloat16))
+
+        dt = _timeit_chained(step, (q, k, v), iters)
     else:
-        f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
-    dt = _timeit(f, (q, k, v), iters)
+        def step(carry):
+            qq, kk, vv = carry
+            out = flash_attention(qq, kk, vv, causal=True)
+            return (out, kk, vv)
+
+        dt = _timeit_chained(step, (q, k, v), iters)
     # causal attention flops: 2 gemms, half the square
     flops = (2 * 2 * b * h * s * s * d) / 2 * (3.5 if bwd else 1)
     return {"op": f"flash_attention_{'bwd' if bwd else 'fwd'}",
@@ -69,16 +111,18 @@ def bench_flash_attention(b=4, s=2048, h=16, d=64, iters=10, bwd=False):
             "TFLOP/s": round(flops / dt / 1e12, 2)}
 
 
-def bench_quantizer(numel=64 * 1024 * 1024, bits=8, iters=10):
-    import jax
+def bench_quantizer(numel=64 * 1024 * 1024, bits=8, iters=20):
     import jax.numpy as jnp
     from deepspeed_tpu.ops.quantizer.kernels import quantize, dequantize
 
     x = jnp.ones((numel,), jnp.bfloat16)
     groups = numel // 2048
-    f = jax.jit(lambda t: dequantize(*quantize(t, groups, num_bits=bits),
-                                     num_bits=bits))
-    dt = _timeit(f, (x,), iters)
+
+    def step(t):
+        return dequantize(*quantize(t, groups, num_bits=bits),
+                          num_bits=bits).reshape(t.shape).astype(t.dtype)
+
+    dt = _timeit_chained(step, x, iters)
     return {"op": f"quant_dequant_int{bits}", "numel": numel,
             "ms": round(dt * 1e3, 3),
             "GB/s": round(numel * 2 / dt / 1e9, 1)}
@@ -87,7 +131,7 @@ def bench_quantizer(numel=64 * 1024 * 1024, bits=8, iters=10):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--ops", default="adam,flash_fwd,flash_bwd,quant")
-    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--iters", type=int, default=20)
     args = ap.parse_args()
     runners = {
         "adam": lambda: bench_adam(iters=args.iters),

@@ -436,7 +436,9 @@ class MLP(nn.Module):
                         dtype=cfg.jnp_dtype, param_dtype=jnp.float32)
         act = {"relu": nn.relu, "gelu": nn.gelu,
                "gelu_exact": partial(nn.gelu, approximate=False),
-               "silu": nn.silu}[cfg.activation]
+               "silu": nn.silu,
+               # clip text encoder: x * sigmoid(1.702 x)
+               "quick_gelu": lambda x: x * nn.sigmoid(1.702 * x)}[cfg.activation]
         if cfg.gated_mlp:
             gate = dense(cfg.ffn_size, name="gate_proj")(x)
             up = dense(cfg.ffn_size, name="up_proj")(x)

@@ -454,6 +454,60 @@ class BertPolicy(HFPolicy):
         return flat
 
 
+class ClipTextPolicy(HFPolicy):
+    """CLIP text encoder (reference ``containers/clip.py`` DS_CLIPContainer /
+    ``HFCLIPLayerPolicy``): causal pre-LN encoder with quick-gelu MLPs —
+    structurally our decoder trunk; consumers read ``hidden_states`` (the
+    vision tower rides ``model_implementations/transformers/clip_encoder``).
+    Accepts a bare ``CLIPTextModel`` or a full ``CLIPModel`` (text tower)."""
+
+    model_types = ("clip_text_model", "clip")
+
+    def build_config(self, hf, **over):
+        if hasattr(hf, "text_config"):      # full CLIPModel config
+            hf = hf.text_config
+        base = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            num_layers=hf.num_hidden_layers,
+            num_heads=hf.num_attention_heads,
+            ffn_hidden_size=hf.intermediate_size,
+            max_seq_len=hf.max_position_embeddings,
+            activation=ACT_MAP[hf.hidden_act],
+            position_embedding="learned",
+            layernorm_epsilon=hf.layer_norm_eps,
+            # encoder: no LM head; tied head keeps the param tree headless
+            tie_word_embeddings=True,
+        )
+        base.update(over)
+        return TransformerConfig(**base)
+
+    @staticmethod
+    def _pfx(sd):
+        return "text_model." if any(k.startswith("text_model.") for k in sd) \
+            else ""
+
+    def top_params(self, sd, cfg):
+        p = self._pfx(sd)
+        out = {"embed_tokens/embedding":
+                   _np(sd[f"{p}embeddings.token_embedding.weight"]),
+               "embed_positions/embedding":
+                   _np(sd[f"{p}embeddings.position_embedding.weight"])}
+        out.update(self.norm(sd, f"{p}final_layer_norm", "final_norm"))
+        return out
+
+    def layer_params(self, sd, i, cfg):
+        p = f"{self._pfx(sd)}encoder.layers.{i}"
+        out = self.attn_separate(sd, f"{p}.self_attn", cfg)
+        out.update(self.norm(sd, f"{p}.layer_norm1", "input_norm"))
+        out.update(self.norm(sd, f"{p}.layer_norm2", "post_attn_norm"))
+        out["mlp/up_proj/kernel"] = linear_kernel(sd[f"{p}.mlp.fc1.weight"])
+        out["mlp/up_proj/bias"] = _np(sd[f"{p}.mlp.fc1.bias"])
+        out["mlp/down_proj/kernel"] = linear_kernel(sd[f"{p}.mlp.fc2.weight"])
+        out["mlp/down_proj/bias"] = _np(sd[f"{p}.mlp.fc2.bias"])
+        return out
+
+
 class MegatronGPTPolicy(HFPolicy):
     """Megatron-LM GPT checkpoints (reference ``containers/megatron_gpt.py``
     + ``replace_policy.py`` MegatronLayerPolicy): pre-LN GPT-2 architecture
@@ -618,4 +672,4 @@ class DistilBertPolicy(BertPolicy):
 
 ALL_POLICIES = [OPTPolicy, GPT2Policy, LlamaPolicy, BloomPolicy,
                 GPTNeoXPolicy, GPTJPolicy, GPTNeoPolicy, BertPolicy,
-                DistilBertPolicy, MegatronGPTPolicy]
+                DistilBertPolicy, MegatronGPTPolicy, ClipTextPolicy]

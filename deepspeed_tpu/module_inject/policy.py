@@ -31,6 +31,7 @@ ACT_MAP = {
     "gelu_pytorch_tanh": "gelu",
     "silu": "silu",
     "swish": "silu",
+    "quick_gelu": "quick_gelu",
 }
 
 

@@ -133,10 +133,14 @@ class MegatronSDLoader(SDLoaderBase):
                 # concat on the output axis is correct there.
                 merged[name] = self._merge_qkv(parts, name)
             elif VOCAB_EMBED_RE.search(name) and first.ndim == 2 \
-                    and not all((p == parts[0]).all() for p in parts[1:]):
-                # megatron VocabParallelEmbedding: shards differ → the vocab
-                # dim is TP-sharded, concatenate it.  (Equal shards mean a
-                # replicated embedding — inference-export checkpoints.)
+                    and (any(p.shape != first.shape for p in parts[1:])
+                         or not all(np.array_equal(p, first)
+                                    for p in parts[1:])):
+                # megatron VocabParallelEmbedding: differing shards → the
+                # vocab dim is TP-sharded, concatenate it (shape check first:
+                # unevenly-split shards must not hit the elementwise compare).
+                # Identical shards mean a replicated embedding
+                # (inference-export checkpoints).
                 merged[name] = np.concatenate(parts, axis=0)
             elif first.ndim == 0 or kind == "replicated":
                 merged[name] = parts[0]
@@ -185,6 +189,12 @@ class MegatronSDLoader(SDLoaderBase):
                 out[name] = np.concatenate(
                     [np.split(t, mp_world_size, axis=0)[mp_rank]
                      for t in (q, k, v)], axis=0)
+                continue
+            if VOCAB_EMBED_RE.search(name) and w.ndim == 2:
+                # inverse of the merge-side vocab concat: shard the vocab dim
+                assert w.shape[0] % mp_world_size == 0, \
+                    f"{name}: vocab {w.shape[0]} not divisible by TP degree"
+                out[name] = np.split(w, mp_world_size, axis=0)[mp_rank]
                 continue
             if w.ndim == 0 or kind == "replicated":
                 out[name] = w

@@ -238,3 +238,24 @@ def test_megatron_checkpoint_loads_with_tp_merge(tmp_path):
     got = np.asarray(jax.jit(
         lambda p, i: model.apply(p, i, method=type(model).logits))(params, ids))
     np.testing.assert_allclose(got, hf_logits(hf, ids), atol=1e-4, rtol=1e-4)
+
+
+def test_clip_text_encoder_parity():
+    """CLIP text tower (reference ``containers/clip.py``): causal pre-LN
+    quick-gelu encoder; our hidden_states must match HF last_hidden_state."""
+    torch.manual_seed(5)
+    cfg = transformers.CLIPTextConfig(
+        vocab_size=99, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=32, hidden_act="quick_gelu")
+    hf = transformers.CLIPTextModel(cfg).eval()
+    model, params = convert_hf_model(hf, use_flash_attention=False,
+                                     dtype="float32")
+    ids = np.random.default_rng(3).integers(0, 99, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        want = hf(input_ids=torch.tensor(ids.astype(np.int64)))\
+            .last_hidden_state.numpy()
+    got = np.asarray(jax.jit(
+        lambda p, i: model.apply(p, i, method=type(model).hidden_states))(
+            params, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)

@@ -159,3 +159,39 @@ def test_moe_transformer_trunk_trains():
     with pytest.raises(ValueError):
         TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2,
                           num_heads=4, moe_num_experts=4, scan_layers=True)
+
+
+def test_moe_trunk_checkpoint_roundtrip(tmp_path):
+    """MoE checkpoint save/load (reference
+    ``tests/unit/checkpoint/test_moe_checkpoint.py``): ep-sharded expert
+    params must survive an engine save/load round trip bit-exactly and
+    come back with their ep sharding."""
+    from deepspeed_tpu.models.transformer import (Transformer,
+                                                  TransformerConfig)
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        max_seq_len=16, dtype="float32", use_flash_attention=False,
+        remat=False, scan_layers=False, moe_num_experts=4, moe_every=2,
+        moe_ep_size=4, moe_capacity_factor=2.0)
+    conf = {"train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+            "moe": {"ep_size": 4},
+            "zero_optimization": {"stage": 1}}
+    engine, *_ = deepspeed_tpu.initialize(model=Transformer(cfg), config=conf)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 64, (8, 16)).astype(np.int32)
+    for _ in range(3):
+        loss = engine({"input_ids": ids})
+        engine.backward(loss)
+        engine.step()
+    engine.save_checkpoint(str(tmp_path))
+    before = jax.device_get(engine.params)
+
+    engine2, *_ = deepspeed_tpu.initialize(model=Transformer(cfg), config=conf)
+    engine2.load_checkpoint(str(tmp_path))
+    after = jax.device_get(engine2.params)
+    jax.tree.map(np.testing.assert_array_equal, before, after)
+    assert engine2.global_steps == engine.global_steps
+    leaves = jax.tree_util.tree_leaves_with_path(engine2.params)
+    expert = [l for p, l in leaves if "experts" in str(p).lower()]
+    assert expert and any("ep" in str(l.sharding.spec) for l in expert)

@@ -267,3 +267,28 @@ def test_megatron_vocab_parallel_embedding_merge(tmp_path):
     merged = MegatronSDLoader(paths, version=2.0).merge_state_dict()
     np.testing.assert_array_equal(merged["word_embeddings.weight"], emb)
     np.testing.assert_array_equal(merged["position_embeddings.weight"], pos)
+
+
+def test_megatron_vocab_embedding_uneven_and_split_symmetry(tmp_path):
+    """Unevenly-split vocab shards must concatenate (no broadcast crash);
+    split_state_dict shards the vocab dim so merge∘split is the identity."""
+    import numpy as np
+    from deepspeed_tpu.runtime.state_dict_factory import MegatronSDLoader
+
+    rng = np.random.default_rng(2)
+    emb = rng.standard_normal((10, 4)).astype(np.float32)
+    paths = []
+    for r, sl in enumerate((slice(0, 6), slice(6, 10))):   # 6 + 4 rows
+        p = tmp_path / f"u{r}.npz"
+        np.savez(p, **{"word_embeddings.weight": emb[sl]})
+        paths.append(str(p))
+    merged = MegatronSDLoader(paths, version=2.0).merge_state_dict()
+    np.testing.assert_array_equal(merged["word_embeddings.weight"], emb)
+
+    # split from a single full checkpoint shards the vocab dim
+    full = tmp_path / "full.npz"
+    np.savez(full, **{"word_embeddings.weight": emb})
+    loader = MegatronSDLoader([str(full)], version=2.0)
+    s0 = loader.split_state_dict(2, 0)["word_embeddings.weight"]
+    s1 = loader.split_state_dict(2, 1)["word_embeddings.weight"]
+    np.testing.assert_array_equal(np.concatenate([s0, s1]), emb)

@@ -21,6 +21,11 @@ from deepspeed_tpu.parallel import topology  # noqa: F401
 from deepspeed_tpu.parallel.topology import ParallelTopology, initialize_topology  # noqa: F401
 from deepspeed_tpu.runtime.config import DeepSpeedConfig  # noqa: F401
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.runtime import zero  # noqa: F401
+from deepspeed_tpu.runtime.pipe.module import PipelineModule, LayerSpec, TiedLayerSpec  # noqa: F401
+from deepspeed_tpu.ops.transformer.transformer import (  # noqa: F401
+    DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing  # noqa: F401
 from deepspeed_tpu.utils.logging import logger, log_dist  # noqa: F401
 
 from deepspeed_tpu.ops.adam.fused_adam import FusedAdam, FusedAdamW  # noqa: F401

@@ -919,6 +919,23 @@ class DeepSpeedEngine:
     def params(self):
         return self._params
 
+    def load_params(self, tree):
+        """Replace the live master params (same structure/shapes), re-placed
+        with the plan's shardings — the write-back half of
+        ``zero.GatheredParameters`` surgery."""
+        if self._params is None or self._plan is None:
+            raise RuntimeError("engine params not initialized yet")
+        import chex
+        chex.assert_trees_all_equal_shapes(tree, self._params)
+        put = jax.jit(
+            lambda t: jax.tree.map(
+                lambda p, old: p.astype(old.dtype), t, self._params),
+            out_shardings=self._plan.param_shardings)
+        self._params = put(tree)
+        # inference views derived from the old params are now stale
+        if hasattr(self, "_infer_params"):
+            self._infer_params = None
+
     def module_state_dict(self):
         return self._params
 

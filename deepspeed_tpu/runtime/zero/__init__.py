@@ -1,0 +1,154 @@
+"""Public ZeRO API — reference ``deepspeed.zero`` surface.
+
+* ``zero.Init`` (reference ``partition_parameters.py:603``): sharded-at-birth
+  parameter initialization.  The reference monkey-patches ``nn.Module`` so
+  every parameter is partitioned the moment it is constructed; under GSPMD
+  the same contract is an ``out_shardings`` on the jitted init program — the
+  full weights never materialize on any single device.  The engine does this
+  automatically (``engine.py _lazy_init``); this context exists for
+  reference-API users who initialize params outside the engine.
+
+* ``zero.GatheredParameters`` (reference ``partition_parameters.py:1553``):
+  temporarily materialize full (unsharded) values of ZeRO-partitioned params
+  for inspection or surgery, then re-scatter with the original shardings on
+  exit — the functional analog of the reference's gather → modify →
+  re-partition protocol (DeepSpeed-Chat uses this for LoRA/EMA surgery).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.zero.partition import (ZeroShardingPlan,  # noqa: F401
+                                                  build_sharding_plan)
+
+_ACTIVE_INIT = []
+
+
+class Init:
+    """Sharded-at-birth init context.
+
+    Usage (engine-external; inside the engine this happens automatically)::
+
+        with zero.Init(config=ds_config) as zinit:
+            params = zinit.materialize(model.init, rng, sample_batch)
+
+    ``materialize`` builds the ZeRO sharding plan from the abstract shapes
+    (``jax.eval_shape`` — no memory) and runs the init program with sharded
+    ``out_shardings``; the plan is exposed as ``.plan``.
+    """
+
+    def __init__(self, module=None, config=None, config_dict_or_path=None,
+                 mesh=None, dtype=None, enabled=True, **_compat_ignored):
+        self.enabled = enabled
+        self.dtype = dtype
+        self.plan = None
+        cfg = config if config is not None else config_dict_or_path
+        self._zero_config = self._resolve_zero_config(cfg)
+        self._mesh = mesh
+
+    @staticmethod
+    def _resolve_zero_config(cfg):
+        if cfg is None:
+            # the reference zero.Init partitions unconditionally — default
+            # to stage 3 so the sharded-at-birth contract holds with no cfg
+            cfg = {"zero_optimization": {"stage": 3}}
+        if isinstance(cfg, dict):
+            from deepspeed_tpu.runtime.config import DeepSpeedConfig
+            full = dict(cfg)
+            full.setdefault("train_micro_batch_size_per_gpu", 1)
+            return DeepSpeedConfig(full).zero_config
+        return getattr(cfg, "zero_config", cfg)
+
+    def __enter__(self):
+        if self.enabled:
+            _ACTIVE_INIT.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        if self.enabled and _ACTIVE_INIT and _ACTIVE_INIT[-1] is self:
+            _ACTIVE_INIT.pop()
+        return False
+
+    @staticmethod
+    def is_active():
+        return bool(_ACTIVE_INIT)
+
+    def materialize(self, init_fn, rng, *args, **kwargs):
+        """Run ``init_fn(rng, *args, **kwargs)`` with ZeRO-sharded outputs."""
+        from deepspeed_tpu.parallel.topology import get_topology
+        topo = get_topology()
+        if self._mesh is not None and self._mesh is not topo.mesh:
+            raise ValueError(
+                "zero.Init(mesh=...) differs from the live topology's mesh — "
+                "shardings are built on the global topology; call "
+                "initialize_topology(...) with the desired axes first")
+        if not self.enabled:
+            return init_fn(rng, *args, **kwargs)
+        abstract = jax.eval_shape(lambda r: init_fn(r, *args, **kwargs), rng)
+        if self.dtype is not None:
+            abstract = jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct(
+                    s.shape, self.dtype
+                    if jnp.issubdtype(s.dtype, jnp.floating) else s.dtype),
+                abstract)
+        self.plan = build_sharding_plan(abstract, topo, self._zero_config)
+        cast = (lambda p: p.astype(self.dtype)
+                if self.dtype is not None
+                and jnp.issubdtype(p.dtype, jnp.floating) else p)
+        init_jit = jax.jit(
+            lambda r: jax.tree.map(cast, init_fn(r, *args, **kwargs)),
+            out_shardings=self.plan.param_shardings)
+        return init_jit(rng)
+
+
+class GatheredParameters:
+    """Materialize sharded params as host numpy arrays, re-shard on exit.
+
+    ::
+
+        with zero.GatheredParameters(engine.params) as g:
+            g.full["embed_tokens"]["embedding"][:vocab] = new_rows
+        engine.load_params(g.params)    # re-sharded pytree
+
+    ``full`` is a pytree of *mutable* numpy arrays (in-place surgery is the
+    point); ``params`` (available after exit) is the re-sharded device tree.
+    ``modifier_rank`` is accepted for API parity — under SPMD every process
+    executes the same surgery, which IS the rank-0-then-broadcast semantics
+    of the reference.
+    """
+
+    def __init__(self, params, modifier_rank=0, fwd_module=None, enabled=True):
+        self.enabled = enabled
+        self._src = params
+        self.full = None
+        self.params = None
+        self._shardings = None
+
+    def __enter__(self):
+        if not self.enabled:
+            self.full = self._src
+            return self
+        self._shardings = jax.tree.map(lambda l: l.sharding, self._src)
+
+        def gather(l):
+            if hasattr(l, "is_fully_addressable") and \
+                    not l.is_fully_addressable:
+                # multi-host: shards live on non-addressable devices — pull
+                # every process's shards (the reference gathers via NCCL)
+                from jax.experimental import multihost_utils
+                return np.array(multihost_utils.process_allgather(
+                    l, tiled=True))
+            return np.array(jax.device_get(l))
+        self.full = jax.tree.map(gather, self._src)
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        if exc_type is not None or not self.enabled:
+            self.params = self._src
+            return False
+        self.params = jax.tree.map(
+            lambda arr, sh: jax.device_put(jnp.asarray(arr), sh),
+            self.full, self._shardings)
+        return False

@@ -1,0 +1,106 @@
+"""Public zero API tests (reference ``deepspeed.zero``): Init sharded-at-
+birth materialization and GatheredParameters gather→surgery→re-shard."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.topology import initialize_topology, reset_topology
+
+
+class Net(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(64)(nn.relu(nn.Dense(128)(x)))
+
+
+def test_zero_init_materializes_sharded():
+    reset_topology()
+    initialize_topology(dp=8)
+    try:
+        model = Net()
+        with deepspeed_tpu.zero.Init(
+                config={"zero_optimization": {"stage": 3}}) as zinit:
+            assert deepspeed_tpu.zero.Init.is_active()
+            params = zinit.materialize(model.init, jax.random.key(0),
+                                       jnp.ones((2, 16)))
+        assert not deepspeed_tpu.zero.Init.is_active()
+        # stage 3: param leaves sharded over the dp axis where divisible
+        leaves = jax.tree.leaves(params)
+        assert any(not l.sharding.is_fully_replicated for l in leaves)
+        assert zinit.plan is not None
+        # forward works from the sharded tree
+        out = jax.jit(model.apply)(params, jnp.ones((2, 16)))
+        assert out.shape == (2, 64)
+    finally:
+        reset_topology()
+
+
+def test_gathered_parameters_surgery_roundtrip():
+    reset_topology()
+    initialize_topology(dp=8)
+    try:
+        model = Net()
+        with deepspeed_tpu.zero.Init(
+                config={"zero_optimization": {"stage": 3}}) as zinit:
+            params = zinit.materialize(model.init, jax.random.key(0),
+                                       jnp.ones((2, 16)))
+        with deepspeed_tpu.zero.GatheredParameters(params) as g:
+            # full numpy view, in-place surgery (layer auto-names differ by
+            # construction order — pick the first Dense)
+            name = sorted(g.full["params"])[0]
+            k = g.full["params"][name]["kernel"]
+            assert isinstance(k, np.ndarray)
+            k[:] = 0.25
+        new = g.params
+        k2 = new["params"][name]["kernel"]
+        # sharding preserved, values updated
+        assert k2.sharding == params["params"][name]["kernel"].sharding
+        np.testing.assert_allclose(np.asarray(jax.device_get(k2)), 0.25)
+        # disabled context passes through
+        with deepspeed_tpu.zero.GatheredParameters(params, enabled=False) as g2:
+            assert g2.full is params
+    finally:
+        reset_topology()
+
+
+def test_gathered_parameters_engine_writeback():
+    """Full reference workflow: engine → gather → surgery → load_params →
+    training continues with the modified weights."""
+    reset_topology()
+    try:
+        from simple_model import SimpleModel, random_batch
+        engine, *_ = deepspeed_tpu.initialize(
+            model=SimpleModel(),
+            config={"train_micro_batch_size_per_gpu": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 3}})
+        loss = engine(random_batch())
+        engine.backward(loss)
+        engine.step()
+
+        with deepspeed_tpu.zero.GatheredParameters(engine.params) as g:
+            name = sorted(g.full["params"])[0]
+            g.full["params"][name]["kernel"][:] = 0.125
+        engine.load_params(g.params)
+        got = np.asarray(jax.device_get(
+            engine.params["params"][name]["kernel"]))
+        np.testing.assert_allclose(got, 0.125)
+        # sharding preserved and training still runs
+        assert engine.params["params"][name]["kernel"].sharding == \
+            g.params["params"][name]["kernel"].sharding
+        loss = engine(random_batch())
+        engine.backward(loss)
+        engine.step()
+
+        # default zero.Init (no config) shards at birth (stage-3 contract)
+        with deepspeed_tpu.zero.Init() as zi:
+            p = zi.materialize(Net().init, jax.random.key(1),
+                               jnp.ones((2, 16)))
+        assert any(not l.sharding.is_fully_replicated
+                   for l in jax.tree.leaves(p))
+    finally:
+        reset_topology()

@@ -17,6 +17,8 @@ flax-first so that:
   ``jax.checkpoint`` policies from the activation-checkpointing config.
 """
 
+import os
+
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Optional
@@ -682,7 +684,16 @@ def chunked_cross_entropy_loss(h, labels, head_fn, n_chunks,
         gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
         return jnp.sum((logz - gold) * valid), jnp.sum(valid)
 
-    sums, counts = jax.lax.map(one, (hc, lc))
+    if os.environ.get("DSTPU_LOSS_CHUNK_UNROLL", "0") == "1":
+        # unrolled variant: lets XLA interleave chunk i's CE (VPU) with
+        # chunk i+1's head matmul (MXU).  Benched at parity-or-slightly-
+        # worse vs lax.map on v5e (37.6 vs 38.0 MFU) — the while loop's
+        # serialization is already hidden; kept as an escape hatch.
+        parts = [one((hc[i], lc[i])) for i in range(n_chunks)]
+        sums = jnp.stack([p[0] for p in parts])
+        counts = jnp.stack([p[1] for p in parts])
+    else:
+        sums, counts = jax.lax.map(one, (hc, lc))
     return jnp.sum(sums) / jnp.maximum(jnp.sum(counts), 1)
 
 

@@ -121,6 +121,16 @@ class FlopsProfilerConfig(DeepSpeedConfigModel):
     output_file: Optional[str] = None
 
 
+class NebulaConfig(DeepSpeedConfigModel):
+    """Reference ``deepspeed/nebula/config.py`` block: async tiered
+    checkpoint save.  On TPU 'nebula' selects the async Orbax engine."""
+    enabled: bool = False
+    persistent_storage_path: Optional[str] = None
+    persistent_time_interval: int = 100
+    num_of_version_in_retention: int = 2
+    enable_nebula_load: bool = True
+
+
 class CommsLoggerConfig(DeepSpeedConfigModel):
     enabled: bool = False
     verbose: bool = False
@@ -298,6 +308,7 @@ class DeepSpeedConfig:
             **pd.get(C.CURRICULUM_LEARNING_LEGACY, {}))
         self.data_efficiency = DataEfficiencyConfig(**pd.get(C.DATA_EFFICIENCY, {}))
         self.autotuning_config = AutotuningConfig(**pd.get(C.AUTOTUNING, {}))
+        self.nebula_config = NebulaConfig(**pd.get("nebula", {}))
 
         self.gradient_clipping = pd.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
         self.prescale_gradients = pd.get(C.PRESCALE_GRADIENTS, False)

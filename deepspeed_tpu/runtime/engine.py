@@ -202,7 +202,16 @@ class DeepSpeedEngine:
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn)
 
-        self.checkpoint_engine = OrbaxCheckpointEngine()
+        # reference engine.py:858 _configure_checkpointing: nebula block
+        # selects the async tiered engine
+        if getattr(self._config, "nebula_config", None) is not None \
+                and self._config.nebula_config.enabled:
+            from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine \
+                import NebulaCheckpointEngine
+            self.checkpoint_engine = NebulaCheckpointEngine(
+                self._config.nebula_config)
+        else:
+            self.checkpoint_engine = OrbaxCheckpointEngine()
         self.flops_profiler = None
         if self._config.flops_profiler.enabled:
             from deepspeed_tpu.profiling.flops_profiler.profiler import FlopsProfiler
@@ -932,8 +941,14 @@ class DeepSpeedEngine:
                 lambda p, old: p.astype(old.dtype), t, self._params),
             out_shardings=self._plan.param_shardings)
         self._params = put(tree)
-        # inference views derived from the old params are now stale
-        if hasattr(self, "_infer_params"):
+        if self._host_opt is not None:
+            # ZeRO-Offload: the host fp32 masters are authoritative — the
+            # next _offload_step overwrites device params from them, so the
+            # surgery must be re-seeded there too (same as load_checkpoint)
+            self._host_opt.init_from_params(self._params)
+        # hybrid engine caches a bf16 inference view keyed on global_steps;
+        # surgery changes weights without a step, so drop it explicitly
+        if getattr(self, "_infer_params", None) is not None:
             self._infer_params = None
 
     def module_state_dict(self):

@@ -54,6 +54,10 @@ class Init:
             # the reference zero.Init partitions unconditionally — default
             # to stage 3 so the sharded-at-birth contract holds with no cfg
             cfg = {"zero_optimization": {"stage": 3}}
+        if isinstance(cfg, str):          # path to a DeepSpeed config json
+            import json
+            with open(cfg) as f:
+                cfg = json.load(f)
         if isinstance(cfg, dict):
             from deepspeed_tpu.runtime.config import DeepSpeedConfig
             full = dict(cfg)
@@ -77,6 +81,8 @@ class Init:
 
     def materialize(self, init_fn, rng, *args, **kwargs):
         """Run ``init_fn(rng, *args, **kwargs)`` with ZeRO-sharded outputs."""
+        if not self.enabled:              # pure passthrough, no side effects
+            return init_fn(rng, *args, **kwargs)
         from deepspeed_tpu.parallel.topology import get_topology
         topo = get_topology()
         if self._mesh is not None and self._mesh is not topo.mesh:
@@ -84,8 +90,6 @@ class Init:
                 "zero.Init(mesh=...) differs from the live topology's mesh — "
                 "shardings are built on the global topology; call "
                 "initialize_topology(...) with the desired axes first")
-        if not self.enabled:
-            return init_fn(rng, *args, **kwargs)
         abstract = jax.eval_shape(lambda r: init_fn(r, *args, **kwargs), rng)
         if self.dtype is not None:
             abstract = jax.tree.map(
@@ -116,7 +120,10 @@ class GatheredParameters:
     point); ``params`` (available after exit) is the re-sharded device tree.
     ``modifier_rank`` is accepted for API parity — under SPMD every process
     executes the same surgery, which IS the rank-0-then-broadcast semantics
-    of the reference.
+    of the reference.  ``enabled`` is accepted for parity too; jax arrays
+    are immutable regardless of sharding, so the mutable-host-copy protocol
+    runs either way (the reference's disabled path hands back the live
+    torch tensors, which are already mutable).
     """
 
     def __init__(self, params, modifier_rank=0, fwd_module=None, enabled=True):
@@ -127,9 +134,6 @@ class GatheredParameters:
         self._shardings = None
 
     def __enter__(self):
-        if not self.enabled:
-            self.full = self._src
-            return self
         self._shardings = jax.tree.map(lambda l: l.sharding, self._src)
 
         def gather(l):
@@ -145,10 +149,13 @@ class GatheredParameters:
         return self
 
     def __exit__(self, exc_type, *exc):
-        if exc_type is not None or not self.enabled:
+        if exc_type is not None:
             self.params = self._src
             return False
+        # device_put straight from host numpy: each device receives only its
+        # shard — wrapping in jnp.asarray first would commit the FULL tensor
+        # to one device before resharding (an HBM spike that defeats ZeRO)
         self.params = jax.tree.map(
-            lambda arr, sh: jax.device_put(jnp.asarray(arr), sh),
+            lambda arr, sh: jax.device_put(arr, sh),
             self.full, self._shardings)
         return False

@@ -191,3 +191,20 @@ def test_chunked_loss_untied_head_matches_full():
     l_full = float(m_full.apply(params, {"input_ids": ids}))
     l_chunk = float(m_chunk.apply(params, {"input_ids": ids}))
     np.testing.assert_allclose(l_chunk, l_full, rtol=1e-5)
+
+
+def test_chunked_loss_unrolled_matches(monkeypatch):
+    """The unrolled chunk-loop escape hatch must be numerically identical
+    to the lax.map path."""
+    from deepspeed_tpu.models.transformer import chunked_cross_entropy_loss
+    import jax, numpy as np, jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    h = jnp.asarray(rng.standard_normal((2, 16, 8)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 10, (2, 16)), jnp.int32)
+    W = jnp.asarray(rng.standard_normal((8, 10)), jnp.float32)
+    head = lambda x: x @ W
+    monkeypatch.setenv("DSTPU_LOSS_CHUNK_UNROLL", "0")
+    a = float(chunked_cross_entropy_loss(h, labels, head, 4))
+    monkeypatch.setenv("DSTPU_LOSS_CHUNK_UNROLL", "1")
+    b = float(chunked_cross_entropy_loss(h, labels, head, 4))
+    np.testing.assert_allclose(a, b, rtol=1e-6)

@@ -247,3 +247,23 @@ def test_offload_load_without_opt_states_reseeds_masters(tmp_path):
     # and one more step keeps training near the loaded point, not init
     loss = _train(engine, 1)
     assert np.isfinite(loss[-1])
+
+
+def test_offload_load_params_reseeds_host_masters():
+    """GatheredParameters surgery + load_params under ZeRO-Offload: the host
+    fp32 masters are authoritative, so load_params must re-seed them or the
+    next step silently reverts the surgery."""
+    engine, *_ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=_offload_config("cpu"))
+    _train(engine, 2)
+    with deepspeed_tpu.zero.GatheredParameters(engine.params) as g:
+        name = sorted(g.full["params"])[0]
+        g.full["params"][name]["kernel"][:] = 0.125
+    engine.load_params(g.params)
+    # one more step: updates start FROM the surgically-set weights
+    _train(engine, 1, seed=50)
+    got = np.asarray(jax.device_get(
+        engine.params["params"][name]["kernel"])).astype(np.float32)
+    # adam with lr 1e-2 moves weights by ~lr per step; surgery must persist
+    # (without re-seeding, values revert to the pre-surgery trajectory ~0)
+    assert np.all(np.abs(got - 0.125) < 0.05), got

@@ -169,3 +169,32 @@ def test_comms_logger_prof_ops_filter():
         assert any("broadcast" in k for k in keys), keys
     finally:
         dist.configure(enabled=False, prof_all=True, prof_ops=[])
+
+
+def test_nebula_config_selects_async_engine(tmp_path):
+    """Reference ``nebula`` config block (engine.py:858
+    _configure_checkpointing): enabled → async tiered checkpoint engine."""
+    import deepspeed_tpu
+    from simple_model import SimpleModel, random_batch
+    from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+        NebulaCheckpointEngine, OrbaxCheckpointEngine)
+    conf = {"train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "nebula": {"enabled": True,
+                       "persistent_storage_path": str(tmp_path)}}
+    engine, *_ = deepspeed_tpu.initialize(model=SimpleModel(), config=conf)
+    assert isinstance(engine.checkpoint_engine, NebulaCheckpointEngine)
+    loss = engine(random_batch())
+    engine.backward(loss)
+    engine.step()
+    engine.save_checkpoint(str(tmp_path))
+    engine2, *_ = deepspeed_tpu.initialize(model=SimpleModel(), config=conf)
+    engine2.load_checkpoint(str(tmp_path))
+    assert engine2.global_steps == engine.global_steps
+
+    # default stays sync orbax
+    engine3, *_ = deepspeed_tpu.initialize(
+        model=SimpleModel(),
+        config={"train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+    assert type(engine3.checkpoint_engine) is OrbaxCheckpointEngine

@@ -671,8 +671,9 @@ def chunked_cross_entropy_loss(h, labels, head_fn, n_chunks,
     of storing the full [B, S, V] fp32 tensor.  Matches
     ``cross_entropy_loss`` exactly (sum-of-nll / count composition)."""
     B, S, _ = h.shape
-    hc = h.reshape(B, n_chunks, S // n_chunks, h.shape[-1]).transpose(1, 0, 2, 3)
-    lc = labels.reshape(B, n_chunks, S // n_chunks).transpose(1, 0, 2)
+    if S % n_chunks:
+        raise ValueError(f"seq_len {S} not divisible by n_chunks {n_chunks}")
+    csz = S // n_chunks
 
     @jax.checkpoint
     def one(args):
@@ -685,14 +686,20 @@ def chunked_cross_entropy_loss(h, labels, head_fn, n_chunks,
         return jnp.sum((logz - gold) * valid), jnp.sum(valid)
 
     if os.environ.get("DSTPU_LOSS_CHUNK_UNROLL", "0") == "1":
-        # unrolled variant: lets XLA interleave chunk i's CE (VPU) with
-        # chunk i+1's head matmul (MXU).  Benched at parity-or-slightly-
-        # worse vs lax.map on v5e (37.6 vs 38.0 MFU) — the while loop's
-        # serialization is already hidden; kept as an escape hatch.
-        parts = [one((hc[i], lc[i])) for i in range(n_chunks)]
+        # unrolled variant: chunks slice h directly (no chunk-major copy of
+        # the full activation, no dynamic-update-slice in the backward) and
+        # XLA can interleave chunk i's CE (VPU) with chunk i+1's head
+        # matmul (MXU)
+        parts = [one((jax.lax.dynamic_slice_in_dim(h, i * csz, csz, axis=1),
+                      jax.lax.dynamic_slice_in_dim(labels, i * csz, csz,
+                                                   axis=1)))
+                 for i in range(n_chunks)]
         sums = jnp.stack([p[0] for p in parts])
         counts = jnp.stack([p[1] for p in parts])
     else:
+        # chunk-major copy once, then a compact while loop over chunks
+        hc = h.reshape(B, n_chunks, csz, h.shape[-1]).transpose(1, 0, 2, 3)
+        lc = labels.reshape(B, n_chunks, csz).transpose(1, 0, 2)
         sums, counts = jax.lax.map(one, (hc, lc))
     return jnp.sum(sums) / jnp.maximum(jnp.sum(counts), 1)
 

@@ -103,7 +103,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         # matmul throughput
         s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
                                 (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32)
+        if scale != 1.0:        # scale is folded into q by the wrapper
+            s = s * scale
         _softmax_update(s, v_ref[0, 0])
 
     @pl.when(run & needs_mask)
@@ -120,7 +122,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             k = jnp.where(valid_kv, k, jnp.zeros_like(k))
             v = jnp.where(valid_kv, v, jnp.zeros_like(v))
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32)
+        if scale != 1.0:
+            s = s * scale
         cols = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
                                                        (block_q, block_k), 1)
         if even_kv:
@@ -215,7 +219,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _accum(p, do, v, k, delta):
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta) * scale).astype(k.dtype)
+        ds = p * (dp - delta)
+        if scale != 1.0:
+            ds = ds * scale
+        ds = ds.astype(k.dtype)
         dq_scr[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
@@ -224,7 +231,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         lse = lse_ref[0, 0][:, 0:1]                  # [bq, 1]
         s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
                                 (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32)
+        if scale != 1.0:        # scale is folded into q by the wrapper
+            s = s * scale
         p = jnp.exp(s - lse)                          # [bq, bk]
         _accum(p, do_ref[0, 0], v_ref[0, 0], k_ref[0, 0],
                delta_ref[0, 0][:, 0:1])
@@ -244,7 +253,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             k = jnp.where(valid_kv, k, jnp.zeros_like(k))
             v = jnp.where(valid_kv, v, jnp.zeros_like(v))
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32)
+        if scale != 1.0:
+            s = s * scale
         cols = ik * block_k + jax.lax.broadcasted_iota(jnp.int32,
                                                        (block_q, block_k), 1)
         if even_kv:
@@ -287,7 +298,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                          preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta) * scale).astype(q.dtype)  # [bq, bk]
+        ds = p * (dp - delta)                         # [bq, bk]
+        if scale != 1.0:
+            ds = ds * scale
+        ds = ds.astype(q.dtype)
         dk_scr[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
 
@@ -296,7 +310,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, 0][:, 0:1]
         s = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
                                 (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32)
+        if scale != 1.0:        # scale is folded into q by the wrapper
+            s = s * scale
         p = jnp.exp(s - lse)
         _accum(p, q_ref[0, 0], v_ref[0, 0], do_ref[0, 0],
                delta_ref[0, 0][:, 0:1])
@@ -320,7 +336,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             delta = jnp.where(valid_q, delta, 0.0)
             lse = jnp.where(valid_q, lse, 0.0)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32)
+        if scale != 1.0:
+            s = s * scale
         rows = iq * block_q + jax.lax.broadcasted_iota(jnp.int32,
                                                        (block_q, block_k), 0)
         if even_q:
@@ -480,10 +498,22 @@ def flash_attention(q, k, v, causal=True, scale=None,
         block_q_bwd = DEFAULT_BLOCK_Q_BWD
     if block_k_bwd is None:
         block_k_bwd = DEFAULT_BLOCK_K_BWD
-    qt = q.transpose(0, 2, 1, 3)
+    # fold the softmax scale into q OUTSIDE the kernel when it is a power
+    # of two (D a power of 4, e.g. D=64 → 0.125): saves a [bq, bk] f32
+    # multiply per score block in fwd AND bwd, and the multiply is EXACT in
+    # q.dtype (mantissa untouched; the chain rule through it restores dq's
+    # scale automatically).  Other scales (D=128 → 2^-3.5) stay in-kernel
+    # in f32 — pre-scaling bf16 q would round every logit.
+    frac = float(np.log2(scale))
+    if frac == round(frac):
+        qt = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)
+        kernel_scale = 1.0
+    else:
+        qt = q.transpose(0, 2, 1, 3)
+        kernel_scale = float(scale)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash_bhsd(qt, kt, vt, float(scale), bool(causal),
+    out = _flash_bhsd(qt, kt, vt, kernel_scale, bool(causal),
                       int(block_q), int(block_k),
                       int(block_q_bwd), int(block_k_bwd))
     return out.transpose(0, 2, 1, 3)

@@ -847,10 +847,13 @@ class DeepSpeedEngine:
             "client_state": client_state or {},
         }
         self.checkpoint_engine.save(arrays, meta, os.path.join(ckpt_dir, "state"))
+        # commit (async engines: wait for durability) BEFORE advancing the
+        # 'latest' pointer — a crash mid-save must leave 'latest' on the
+        # previous complete checkpoint, never a partial one
+        self.checkpoint_engine.commit(tag)
         if save_latest and jax.process_index() == 0:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(str(tag))
-        self.checkpoint_engine.commit(tag)
         log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
         return True
 
@@ -944,8 +947,9 @@ class DeepSpeedEngine:
         if self._host_opt is not None:
             # ZeRO-Offload: the host fp32 masters are authoritative — the
             # next _offload_step overwrites device params from them, so the
-            # surgery must be re-seeded there too (same as load_checkpoint)
-            self._host_opt.init_from_params(self._params)
+            # surgery must be re-seeded there too (values only: Adam
+            # moments and step count survive, unlike init_from_params)
+            self._host_opt.reseed_masters(self._params)
         # hybrid engine caches a bf16 inference view keyed on global_steps;
         # surgery changes weights without a step, so drop it explicitly
         if getattr(self, "_infer_params", None) is not None:

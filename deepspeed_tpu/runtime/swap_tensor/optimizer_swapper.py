@@ -74,6 +74,14 @@ class OptimizerSwapper:
         if not self.pipeline_write:
             self._write.synchronize_writes()
 
+    def update_master(self, name, master):
+        """Overwrite ONLY the master-value file of a group (surgery
+        write-back): moments on disk stay untouched."""
+        g = self.groups[name]
+        self._write.swap_out(g.keys[0], master[:g.numel])
+        if not self.pipeline_write:
+            self._write.synchronize_writes()
+
     def drain(self):
         self._write.synchronize_writes()
 

@@ -120,10 +120,10 @@ class GatheredParameters:
     point); ``params`` (available after exit) is the re-sharded device tree.
     ``modifier_rank`` is accepted for API parity — under SPMD every process
     executes the same surgery, which IS the rank-0-then-broadcast semantics
-    of the reference.  ``enabled`` is accepted for parity too; jax arrays
-    are immutable regardless of sharding, so the mutable-host-copy protocol
-    runs either way (the reference's disabled path hands back the live
-    torch tensors, which are already mutable).
+    of the reference.  ``enabled=False`` (reference: params not
+    ZeRO-partitioned, nothing to gather) is a zero-cost passthrough:
+    ``full``/``params`` are the live — immutable — device tree; surgery
+    requires ``enabled=True`` (jax arrays cannot be mutated in place).
     """
 
     def __init__(self, params, modifier_rank=0, fwd_module=None, enabled=True):
@@ -134,6 +134,9 @@ class GatheredParameters:
         self._shardings = None
 
     def __enter__(self):
+        if not self.enabled:
+            self.full = self._src
+            return self
         self._shardings = jax.tree.map(lambda l: l.sharding, self._src)
 
         def gather(l):
@@ -149,7 +152,7 @@ class GatheredParameters:
         return self
 
     def __exit__(self, exc_type, *exc):
-        if exc_type is not None:
+        if exc_type is not None or not self.enabled:
             self.params = self._src
             return False
         # device_put straight from host numpy: each device receives only its

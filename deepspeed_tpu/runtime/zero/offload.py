@@ -111,6 +111,24 @@ class HostOffloadedAdam:
                 weight_decay=self.weight_decay, adamw_mode=self.adamw_mode,
                 bias_correction=self.bias_correction)
 
+    def reseed_masters(self, params):
+        """Overwrite ONLY the fp32 master values from ``params``, keeping
+        Adam moments and step count — the write-back half of
+        ``zero.GatheredParameters`` surgery (full ``init_from_params``
+        would zero m/v and restart bias correction)."""
+        leaves = jax.tree.leaves(params)
+        if self.nvme:
+            for name, n, leaf in zip(self.names, self.numels, leaves):
+                m = np.asarray(jax.device_get(leaf), np.float32).ravel()
+                self.swapper.update_master(name, m)
+            self.swapper.drain()
+        else:
+            for i, leaf in enumerate(leaves):
+                # device_get views can be read-only; install a fresh
+                # writable master (the native Adam reads the list per step)
+                self.cpu_opt.params[i] = np.ascontiguousarray(
+                    np.asarray(jax.device_get(leaf), np.float32).ravel())
+
     # -------------------------------------------------------------- #
     def step(self, host_grads, lr=None, fp32_out=False):
         """One Adam step over all leaves.  Returns flat per-leaf arrays for

@@ -249,12 +249,15 @@ def test_offload_load_without_opt_states_reseeds_masters(tmp_path):
     assert np.isfinite(loss[-1])
 
 
-def test_offload_load_params_reseeds_host_masters():
+@pytest.mark.parametrize("device", ["cpu", "nvme"])
+def test_offload_load_params_reseeds_host_masters(device, tmp_path):
     """GatheredParameters surgery + load_params under ZeRO-Offload: the host
-    fp32 masters are authoritative, so load_params must re-seed them or the
-    next step silently reverts the surgery."""
+    fp32 masters are authoritative, so load_params must re-seed them (values
+    only — moments survive) or the next step silently reverts the surgery."""
     engine, *_ = deepspeed_tpu.initialize(
-        model=SimpleModel(hidden_dim=16), config=_offload_config("cpu"))
+        model=SimpleModel(hidden_dim=16),
+        config=_offload_config(device,
+                               str(tmp_path) if device == "nvme" else None))
     _train(engine, 2)
     with deepspeed_tpu.zero.GatheredParameters(engine.params) as g:
         name = sorted(g.full["params"])[0]

@@ -33,7 +33,7 @@ from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.parallel import topology as topo_mod
 from deepspeed_tpu.runtime.zero.partition import build_sharding_plan
 from deepspeed_tpu.runtime.config import ZeroConfig
-from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.logging import log_dist, logger
 
 
 class InferenceEngine:
@@ -50,6 +50,13 @@ class InferenceEngine:
                               "float16": jnp.float16,
                               "float32": jnp.float32}[
                                   normalize_dtype_str(self._config.dtype)]
+        self._quantizer = None
+        if self._config.quant.enabled:
+            from deepspeed_tpu.runtime.weight_quantizer import (
+                WeightQuantization)
+            self._quantizer = WeightQuantization(
+                bits=self._config.quant.bits,
+                group_size=self._config.quant.group_size)
         self._params = None
         self._compiled = {}
         self._rng = jax.random.key(0)
@@ -67,6 +74,34 @@ class InferenceEngine:
         return build_sharding_plan(abstract, self.topology, ZeroConfig(stage=0))
 
     def set_params(self, params):
+        if self._quantizer is not None:
+            # INT8/INT4-at-rest (reference WeightQuantization at checkpoint
+            # load): payload+scales live in HBM; dequant runs inside the
+            # jitted programs, fused into each weight's consumer.  Unquantized
+            # leaves (biases/norms) still cast to the compute dtype; all
+            # leaves are placed replicated (quantized TP is unsupported).
+            if self.topology.tp > 1:
+                logger.warning("weight quantization with tp>1: quantized "
+                               "payloads are replicated, not TP-sharded")
+            cast = self.compute_dtype
+            rep = NamedSharding(self.mesh, P())
+
+            def quantize_and_cast(t):
+                t = self._quantizer.quantize_tree(t)
+                from deepspeed_tpu.runtime.weight_quantizer import _is_qw
+                return jax.tree.map(
+                    lambda p: p if _is_qw(p) else (
+                        p.astype(cast)
+                        if jnp.issubdtype(p.dtype, jnp.floating) else p),
+                    t, is_leaf=_is_qw)
+            self._params = jax.jit(quantize_and_cast,
+                                   out_shardings=rep)(params)
+            n = sum(int(np.prod(l.shape))
+                    for l in jax.tree.leaves(self._params))
+            log_dist(f"inference params quantized to "
+                     f"int{self._quantizer.bits}: {n/1e6:.1f}M values",
+                     ranks=[0])
+            return
         abstract = jax.eval_shape(lambda t: t, params)
         self._plan = self._plan_for(abstract)
         cast = self.compute_dtype
@@ -78,6 +113,13 @@ class InferenceEngine:
         n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(self._params))
         log_dist(f"inference params placed: {n/1e6:.1f}M, tp={self.topology.tp}, "
                  f"dtype={cast.__name__}", ranks=[0])
+
+    def _deq(self, params):
+        """Identity for float params; in-trace dequantization when weight
+        quantization is on (called inside every compiled program)."""
+        if self._quantizer is None:
+            return params
+        return self._quantizer.dequantize_tree(params, self.compute_dtype)
 
     def init_params(self, example_ids=None, seed=0):
         """Random init (testing / benchmarking without a checkpoint)."""
@@ -123,16 +165,17 @@ class InferenceEngine:
             has_logits = hasattr(type(self.module), "logits")
             if attention_mask is None:
                 fwd = (lambda p, ids: self.module.apply(
-                    p, ids, method=type(self.module).logits)) if has_logits \
+                    self._deq(p), ids, method=type(self.module).logits)) \
+                    if has_logits \
                     else (lambda p, ids: self.module.apply(
-                        p, {"input_ids": ids}))
+                        self._deq(p), {"input_ids": ids}))
                 self._compiled[key] = jax.jit(fwd)
             else:
                 fwd = (lambda p, ids, m: self.module.apply(
-                    p, ids, m, method=type(self.module).logits)) \
+                    self._deq(p), ids, m, method=type(self.module).logits)) \
                     if has_logits else \
                     (lambda p, ids, m: self.module.apply(
-                        p, {"input_ids": ids, "attention_mask": m}))
+                        self._deq(p), {"input_ids": ids, "attention_mask": m}))
                 self._compiled[key] = jax.jit(fwd)
         args = (self._params, jnp.asarray(input_ids))
         if attention_mask is not None:
@@ -148,7 +191,8 @@ class InferenceEngine:
             return self._compiled[key]
         self._compiled[key] = make_generate_fn(
             self.module, self.compute_dtype, prompt_len, max_new_tokens,
-            do_sample, temperature, top_k, top_p)
+            do_sample, temperature, top_k, top_p,
+            param_transform=self._deq)
         return self._compiled[key]
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
@@ -177,7 +221,8 @@ class InferenceEngine:
 
 
 def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
-                     do_sample, temperature, top_k, top_p):
+                     do_sample, temperature, top_k, top_p,
+                     param_transform=None):
     """Build the jitted generation program: one-pass prefill + lax.scan
     decode loop with greedy / temperature / top-k / top-p sampling.  Shared
     by ``InferenceEngine`` and ``DeepSpeedHybridEngine``.
@@ -204,27 +249,32 @@ def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
         return jax.random.categorical(rng, logits, axis=-1)
 
     def generate(params, input_ids, rng, eos_id):
+        deq = param_transform if param_transform is not None else (lambda p: p)
         B = input_ids.shape[0]
         cache = module.init_cache(B, max_len, dtype=compute_dtype)
-        # prefill the prompt in one pass
-        logits, cache = module.apply(params, input_ids, cache, 0,
+        # prefill the prompt in one pass (dequant fused into the prefill)
+        logits, cache = module.apply(deq(params), input_ids, cache, 0,
                                      method=type(module).decode)
         rng, sub = jax.random.split(rng)
         next_tok = sample_fn(logits[:, -1], sub)
 
+        # the quantized tree rides the scan CARRY and is dequantized inside
+        # the body: at the JAX level the compute-dtype weights are a per-step
+        # temporary, not a loop constant held live across the whole decode
         def step(carry, _):
-            tok, cache, pos, rng, done = carry
-            logits, cache = module.apply(params, tok[:, None], cache, pos,
-                                         method=type(module).decode)
+            tok, cache, pos, rng, done, qparams = carry
+            logits, cache = module.apply(deq(qparams), tok[:, None], cache,
+                                         pos, method=type(module).decode)
             rng, sub = jax.random.split(rng)
             nxt = sample_fn(logits[:, -1], sub)
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)
-            return (nxt, cache, pos + 1, rng, done), nxt
+            return (nxt, cache, pos + 1, rng, done, qparams), nxt
 
         done0 = (next_tok == eos_id)
-        (_, _, _, _, _), toks = jax.lax.scan(
-            step, (next_tok, cache, jnp.asarray(prompt_len), rng, done0),
+        (_, _, _, _, _, _), toks = jax.lax.scan(
+            step, (next_tok, cache, jnp.asarray(prompt_len), rng, done0,
+                   params),
             None, length=max_new_tokens - 1)
         # HF contract: prompt + generated tokens
         return jnp.concatenate([input_ids, next_tok[:, None], toks.T], axis=1)

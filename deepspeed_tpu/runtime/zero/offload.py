@@ -111,6 +111,13 @@ class HostOffloadedAdam:
                 weight_decay=self.weight_decay, adamw_mode=self.adamw_mode,
                 bias_correction=self.bias_correction)
 
+    @staticmethod
+    def _host_master(leaf):
+        """Device leaf → fresh writable fp32 host vector (device_get views
+        can be read-only)."""
+        return np.ascontiguousarray(
+            np.asarray(jax.device_get(leaf), dtype=np.float32).ravel())
+
     def reseed_masters(self, params):
         """Overwrite ONLY the fp32 master values from ``params``, keeping
         Adam moments and step count — the write-back half of
@@ -118,16 +125,14 @@ class HostOffloadedAdam:
         would zero m/v and restart bias correction)."""
         leaves = jax.tree.leaves(params)
         if self.nvme:
-            for name, n, leaf in zip(self.names, self.numels, leaves):
-                m = np.asarray(jax.device_get(leaf), np.float32).ravel()
-                self.swapper.update_master(name, m)
+            for name, leaf in zip(self.names, leaves):
+                self.swapper.update_master(name, self._host_master(leaf))
             self.swapper.drain()
         else:
             for i, leaf in enumerate(leaves):
-                # device_get views can be read-only; install a fresh
-                # writable master (the native Adam reads the list per step)
-                self.cpu_opt.params[i] = np.ascontiguousarray(
-                    np.asarray(jax.device_get(leaf), np.float32).ravel())
+                # the native Adam reads the list per step — installing a
+                # fresh array is safe
+                self.cpu_opt.params[i] = self._host_master(leaf)
 
     # -------------------------------------------------------------- #
     def step(self, host_grads, lr=None, fp32_out=False):

@@ -1,0 +1,7 @@
+"""Eigenvalue estimation (reference ``runtime/eigenvalue.py:12``).
+
+The implementation lives beside its only consumer, the MoQ quantizer
+(``runtime/quantize.py`` — reference wires both at ``engine.py:1528``);
+this module preserves the reference's import path."""
+
+from deepspeed_tpu.runtime.quantize import Eigenvalue  # noqa: F401

@@ -24,17 +24,21 @@ from deepspeed_tpu.utils.logging import logger
 class QuantizedWeight:
     """Pytree node for one quantized tensor: payload ``q`` ([G, group] int8,
     or nibble-packed uint8 for 4-bit), per-group ``scale``/``zero``, and the
-    original ``shape``/``bits`` as static metadata."""
+    original ``shape``/``bits``/``symmetric`` as static metadata (dequant
+    must read the tensor's OWN metadata, not the deserializing quantizer's
+    settings)."""
 
-    def __init__(self, q, scale, zero, shape, bits):
+    def __init__(self, q, scale, zero, shape, bits, symmetric=True):
         self.q = q
         self.scale = scale
         self.zero = zero
         self.shape = tuple(shape)
         self.bits = int(bits)
+        self.symmetric = bool(symmetric)
 
     def tree_flatten(self):
-        return (self.q, self.scale, self.zero), (self.shape, self.bits)
+        return ((self.q, self.scale, self.zero),
+                (self.shape, self.bits, self.symmetric))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -58,16 +62,15 @@ class WeightQuantization:
                  mlp_extra_grouping=False, mp_size=1):
         if bits not in (4, 8):
             raise ValueError(f"bits must be 4 or 8, got {bits}")
+        if mlp_extra_grouping or mp_size != 1:
+            logger.warning(
+                "WeightQuantization: mlp_extra_grouping/mp_size are accepted "
+                "for reference-API compatibility but have no effect here "
+                "(grouping is uniform; TP layout comes from the mesh)")
         self.bits = bits
         self.group_size = group_size
         self.symmetric = symmetric
         self.min_ndim = min_ndim
-
-    def _groups_for(self, numel):
-        g = max(1, numel // self.group_size)
-        while numel % g:
-            g -= 1
-        return g
 
     def should_quantize(self, leaf):
         return hasattr(leaf, "ndim") and leaf.ndim >= self.min_ndim and \
@@ -75,24 +78,28 @@ class WeightQuantization:
 
     def quantize_leaf(self, leaf):
         x = jnp.asarray(leaf)
-        groups = self._groups_for(x.size)
-        q, scale, zero = quantize(x.reshape(-1), groups, num_bits=self.bits,
+        # pad the flat vector to a multiple of group_size: every tensor gets
+        # the CONFIGURED group granularity (prime/awkward sizes must not
+        # collapse to one whole-tensor scale), and the group width stays
+        # even so int4 always nibble-packs
+        gsz = max(2, self.group_size + (self.group_size % 2))
+        pad = (-x.size) % gsz
+        flat = jnp.pad(x.reshape(-1), (0, pad))
+        groups = flat.size // gsz
+        q, scale, zero = quantize(flat, groups, num_bits=self.bits,
                                   symmetric=self.symmetric)
-        if self.bits == 4:
-            if q.shape[1] % 2:       # odd group width can't nibble-pack
-                return QuantizedWeight(q.astype(jnp.int8), scale, zero,
-                                       x.shape, 8)
-            q = pack_int4(q)         # [G, group/2] uint8 — real 4-bit HBM
-        else:
-            q = q.astype(jnp.int8)
-        return QuantizedWeight(q, scale, zero, x.shape, self.bits)
+        q = pack_int4(q) if self.bits == 4 else q.astype(jnp.int8)
+        return QuantizedWeight(q, scale, zero, x.shape, self.bits,
+                               self.symmetric)
 
-    def dequantize_leaf(self, qw, dtype=jnp.bfloat16):
+    @staticmethod
+    def dequantize_leaf(qw, dtype=jnp.bfloat16):
         q = unpack_int4(qw.q) if qw.bits == 4 else qw.q
         groups = qw.scale.shape[0]
         flat = dequantize(q.reshape(groups, -1), qw.scale, qw.zero,
-                          num_bits=qw.bits, symmetric=self.symmetric)
-        return flat.reshape(qw.shape).astype(dtype)
+                          num_bits=qw.bits, symmetric=qw.symmetric)
+        numel = int(np.prod(qw.shape))
+        return flat.reshape(-1)[:numel].reshape(qw.shape).astype(dtype)
 
     def quantize_tree(self, params):
         n_q = [0]

@@ -116,3 +116,38 @@ def test_maybe_drop_layer_expectation():
     mean = float(jnp.mean(jnp.stack(outs)))
     # E[out] = x + E[keep/p](out-x) = 3.0
     assert abs(mean - 3.0) < 0.45
+
+
+def test_weight_quantizer_awkward_shapes_and_asymmetric():
+    """WeightQuantization edge cases: prime-sized tensors keep the
+    configured group granularity (padding, no whole-tensor collapse);
+    asymmetric int4 round-trips via the tensor's OWN metadata."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.runtime.weight_quantizer import (QuantizedWeight,
+                                                        WeightQuantization)
+    rng = np.random.default_rng(0)
+
+    # awkward numel (89*89, coprime with 64) with an outlier: per-group
+    # scales must localize it
+    x = rng.standard_normal(89 * 89).astype(np.float32)
+    x[13] = 100.0
+    wq = WeightQuantization(bits=8, group_size=64, min_ndim=1)
+    qw = wq.quantize_leaf(jnp.asarray(x).reshape(89, 89))
+    assert qw.scale.shape[0] > 100          # real groups, not 1
+    back = np.asarray(wq.dequantize_leaf(qw, jnp.float32)).reshape(-1)
+    # the outlier coarsens ONLY its own group (~group_size elems); all
+    # other groups keep fine scales
+    err = np.abs(back - x)
+    assert (err > 2e-2).sum() <= 70, (err > 2e-2).sum()
+    assert err[200:].max() < 2e-2    # far from the outlier: tight
+
+    # asymmetric int4: dequant reads qw.symmetric/bits, not the decoder's
+    y = jnp.asarray(rng.standard_normal((9, 9)), jnp.float32)  # odd dims
+    wq4 = WeightQuantization(bits=4, group_size=32, symmetric=False)
+    qw4 = wq4.quantize_leaf(y)
+    assert qw4.bits == 4 and not qw4.symmetric
+    decoder = WeightQuantization()           # default symmetric int8
+    back4 = np.asarray(decoder.dequantize_leaf(qw4, jnp.float32))
+    err = np.abs(back4 - np.asarray(y)).max()
+    assert err < 0.3, err                    # int4 coarse but sane

@@ -137,8 +137,6 @@ class BF16_Optimizer:
             self.fp32_groups_flat = jax.tree.map(
                 jax.device_put, self.fp32_groups_flat, shardings)
         if load_optimizer_states and sd.get("optimizer_state") is not None:
-            opt = sd["optimizer_state"]
-            if self.opt_state is not None and hasattr(self.opt_state, "_fields") \
-                    and isinstance(opt, dict):
-                opt = type(self.opt_state)(**opt)
-            self.opt_state = opt
+            from deepspeed_tpu.runtime.utils import rehydrate_opt_state
+            self.opt_state = rehydrate_opt_state(self.opt_state,
+                                                 sd["optimizer_state"])

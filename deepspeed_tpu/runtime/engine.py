@@ -324,8 +324,10 @@ class DeepSpeedEngine:
             return out[0], out[1:]
         return out, ()
 
-    def _init_params_from(self, params):
-        """Place user-provided params: cast to fp32 master, shard per plan."""
+    def _init_params_from(self, params, materialize_opt=True):
+        """Place user-provided params: cast to fp32 master, shard per plan.
+        ``materialize_opt=False`` computes optimizer shardings only (the
+        caller will install loaded state) — no fresh m/v allocation."""
         abstract = jax.eval_shape(lambda t: jax.tree.map(
             lambda p: p.astype(jnp.float32)
             if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else jnp.asarray(p),
@@ -337,14 +339,14 @@ class DeepSpeedEngine:
                 if jnp.issubdtype(p.dtype, jnp.floating) else p, t),
             out_shardings=self._plan.param_shardings)
         self._params = put(params)
-        self._init_opt_state()
+        self._init_opt_state(materialize=materialize_opt)
 
     def _build_plan(self, abstract_params):
         self._plan = build_sharding_plan(abstract_params, self.topology,
                                          self._config.zero_config)
         self._abstract_params = abstract_params
 
-    def _init_opt_state(self):
+    def _init_opt_state(self, materialize=True):
         if self._offload_cfg is not None:
             from deepspeed_tpu.runtime.zero.offload import HostOffloadedAdam
             opt = self.optimizer
@@ -372,6 +374,9 @@ class DeepSpeedEngine:
         abstract_opt = jax.eval_shape(self.optimizer.init, self._abstract_params)
         self._opt_shardings = _opt_state_shardings(
             abstract_opt, self._abstract_params, self._plan.opt_specs, self.mesh)
+        if not materialize:        # caller installs loaded state itself
+            self._abstract_opt = abstract_opt
+            return
         init_jit = jax.jit(self.optimizer.init, out_shardings=self._opt_shardings)
         self._opt_state = init_jit(self._params)
 
@@ -878,7 +883,11 @@ class DeepSpeedEngine:
         arrays, meta = self.checkpoint_engine.load(path, abstract_arrays=abstract)
         self._params = arrays["module"]
         if load_module_only:
-            if self._host_opt is not None:
+            if self._plan is None and self._host_opt is None:
+                # fresh engine: build the plan and re-place the loaded
+                # weights (fresh optimizer state — module only)
+                self._init_params_from(self._params)
+            elif self._host_opt is not None:
                 # fresh masters from the loaded weights — stale fp32 masters
                 # would overwrite them on the next offload step
                 self._host_opt.init_from_params(self._params)
@@ -893,11 +902,9 @@ class DeepSpeedEngine:
                 # and silently overwrite the checkpoint's weights
                 self._host_opt.init_from_params(self._params)
         if load_optimizer_states and arrays.get("optimizer") is not None:
-            opt = arrays["optimizer"]
-            if self._opt_state is not None and hasattr(self._opt_state, "_fields") \
-                    and isinstance(opt, dict):
-                opt = type(self._opt_state)(**opt)
-            self._opt_state = opt
+            from deepspeed_tpu.runtime.utils import rehydrate_opt_state
+            self._opt_state = rehydrate_opt_state(self._opt_state,
+                                                  arrays["optimizer"])
         if arrays.get("loss_scaler") is not None:
             sc = arrays["loss_scaler"]
             if isinstance(sc, dict):
@@ -910,6 +917,30 @@ class DeepSpeedEngine:
         self.skipped_steps = meta.get("skipped_steps", 0)
         if load_lr_scheduler_states and self.lr_scheduler and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        if self._plan is None and self._host_opt is None:
+            # checkpoint loaded into a fresh engine, possibly on a DIFFERENT
+            # topology than it was saved from (the reference's universal-
+            # checkpoint resize): build this engine's sharding plan from the
+            # loaded shapes and re-place params + optimizer state under it.
+            loaded_opt = self._opt_state
+            have_loaded_opt = load_optimizer_states and loaded_opt is not None
+            self._opt_state = None
+            # when loaded state exists, compute shardings only — allocating
+            # a fresh m/v just to overwrite it would spike HBM
+            self._init_params_from(self._params,
+                                   materialize_opt=not have_loaded_opt)
+            if self._host_opt is not None:
+                # offload engine born from this load: prefer the saved host
+                # optimizer states over the fresh init_from_params seed
+                if load_optimizer_states and os.path.isdir(host_opt_dir):
+                    self._host_opt.load(host_opt_dir)
+            elif have_loaded_opt and self._opt_shardings is not None:
+                from deepspeed_tpu.runtime.utils import rehydrate_opt_state
+                loaded_opt = rehydrate_opt_state(
+                    getattr(self, "_abstract_opt", None), loaded_opt)
+                self._opt_state = jax.jit(
+                    lambda t: t,
+                    out_shardings=self._opt_shardings)(loaded_opt)
         state = meta
         log_dist(f"loaded checkpoint {tag} from {load_dir}", ranks=[0])
         return path, state.get("client_state", {})

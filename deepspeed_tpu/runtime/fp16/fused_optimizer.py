@@ -124,11 +124,9 @@ class FP16_Optimizer:
         self.fp32_groups_flat = jax.tree.map(jnp.asarray,
                                              sd["fp32_groups_flat"])
         if load_optimizer_states and sd.get("optimizer_state") is not None:
-            opt = sd["optimizer_state"]
-            if self.opt_state is not None and hasattr(self.opt_state, "_fields") \
-                    and isinstance(opt, dict):
-                opt = type(self.opt_state)(**opt)
-            self.opt_state = opt
+            from deepspeed_tpu.runtime.utils import rehydrate_opt_state
+            self.opt_state = rehydrate_opt_state(self.opt_state,
+                                                 sd["optimizer_state"])
         sc = sd.get("loss_scaler")
         if sc is not None:
             self.scaler_state = sc if isinstance(sc, LossScalerState) else \

@@ -165,3 +165,13 @@ class PartitionedTensor:
         flat = jnp.concatenate(self.parts)
         n = int(np.prod(self.orig_shape))
         return flat[:n].reshape(self.orig_shape)
+
+
+def rehydrate_opt_state(template, loaded):
+    """Restore a NamedTuple optimizer state from its dict serialization
+    (checkpoint metadata loses the namedtuple type).  Shared by the engine,
+    BF16/FP16 wrappers and the universal-checkpoint loader."""
+    if template is not None and hasattr(template, "_fields") \
+            and isinstance(loaded, dict):
+        return type(template)(**loaded)
+    return loaded

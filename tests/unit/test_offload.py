@@ -270,3 +270,24 @@ def test_offload_load_params_reseeds_host_masters(device, tmp_path):
     # adam with lr 1e-2 moves weights by ~lr per step; surgery must persist
     # (without re-seeding, values revert to the pre-surgery trajectory ~0)
     assert np.all(np.abs(got - 0.125) < 0.05), got
+
+
+def test_offload_fresh_engine_load_restores_moments(tmp_path):
+    """Checkpoint with offloaded optimizer loaded into a FRESH engine:
+    saved host Adam moments must be restored, not re-zeroed."""
+    ck = tmp_path / "ck"
+    engine, *_ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=_offload_config("cpu"))
+    _train(engine, 4)
+    engine.save_checkpoint(str(ck))
+    want_m = [m.copy() for m in engine._host_opt.cpu_opt.exp_avg]
+    want_step = engine._host_opt.step_count
+
+    fresh, *_ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=_offload_config("cpu"))
+    fresh.load_checkpoint(str(ck))
+    got_m = fresh._host_opt.cpu_opt.exp_avg
+    assert fresh._host_opt.step_count == want_step
+    assert any(np.abs(m).max() > 0 for m in got_m), "moments zeroed"
+    for a, b in zip(want_m, got_m):
+        np.testing.assert_allclose(a, b)

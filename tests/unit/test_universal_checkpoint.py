@@ -130,3 +130,52 @@ def test_tp_reshape_roundtrip():
         merge_tp_shards(out["layers.attn.o_proj.kernel"], dim=0), full_row)
     np.testing.assert_allclose(out["final_norm.scale"][3],
                                flat["final_norm.scale"][0])
+
+
+def test_engine_checkpoint_reshards_across_topologies(tmp_path):
+    """The DistributedFixture elastic-resize analog (reference
+    ``tests/unit/checkpoint/test_zero_optimizer.py``): save under
+    ZeRO-3/dp=8, load into a FRESH engine on tp=2 x dp=4 — values identical,
+    params re-placed under the new plan (tp-sharded), training continues."""
+    from deepspeed_tpu.models.transformer import Transformer, TransformerConfig
+    from deepspeed_tpu.parallel.topology import reset_topology
+
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_heads=4, max_seq_len=16, dtype="float32",
+                            use_flash_attention=False, remat=False)
+    base = {"train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 64, (8, 16)).astype(np.int32)
+
+    try:
+        reset_topology()
+        e1, *_ = deepspeed_tpu.initialize(
+            model=Transformer(cfg),
+            config={**base, "zero_optimization": {"stage": 3}})
+        for _ in range(2):
+            loss = e1({"input_ids": ids})
+            e1.backward(loss)
+            e1.step()
+        e1.save_checkpoint(str(tmp_path))
+        before = jax.device_get(e1.params)
+
+        reset_topology()
+        e2, *_ = deepspeed_tpu.initialize(
+            model=Transformer(cfg),
+            config={**base, "zero_optimization": {"stage": 1},
+                    "tensor_parallel": {"tp_size": 2}})
+        e2.load_checkpoint(str(tmp_path))
+        jax.tree.map(np.testing.assert_array_equal, before,
+                     jax.device_get(e2.params))
+        assert e2.global_steps == e1.global_steps
+        tp_leaves = [l for _, l in
+                     jax.tree_util.tree_leaves_with_path(e2.params)
+                     if "tp" in str(l.sharding.spec)]
+        assert tp_leaves, "no leaf tp-sharded after reshard-on-load"
+        loss = e2({"input_ids": ids})
+        e2.backward(loss)
+        e2.step()
+        assert np.isfinite(float(jax.device_get(loss)))
+    finally:
+        reset_topology()

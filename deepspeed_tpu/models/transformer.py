@@ -73,6 +73,12 @@ class TransformerConfig:
     moe_ep_size: int = 1
     moe_aux_coef: float = 0.01
     lm_head_bias: bool = False               # gptj
+    # opt-350m: embeddings live in a smaller space with project_in /
+    # project_out linears around the trunk (HF word_embed_proj_dim)
+    embed_proj_dim: Optional[int] = None
+    # opt-350m is the post-LN OPT: norms AFTER the residual adds, and no
+    # final norm (HF do_layer_norm_before=False)
+    pre_layer_norm: bool = True
     dropout: float = 0.0
     tie_word_embeddings: bool = False
     dtype: str = "bfloat16"
@@ -479,6 +485,15 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, positions, mask=None, cache=None, train=True):
         cfg = self.config
+        if not cfg.pre_layer_norm:
+            # post-LN (opt-350m): norm follows each residual add
+            attn, new_cache = Attention(cfg, layer_idx=self.layer_idx,
+                                        name="attn")(x, positions, mask,
+                                                     cache)
+            x = _norm(cfg, "input_norm")(x + attn).astype(cfg.jnp_dtype)
+            mlp_out, aux = self._mlp(x, train=train)
+            x = _norm(cfg, "post_attn_norm")(x + mlp_out).astype(cfg.jnp_dtype)
+            return x, new_cache, aux
         normed = _norm(cfg, "input_norm")(x).astype(cfg.jnp_dtype)
         attn, new_cache = Attention(cfg, layer_idx=self.layer_idx,
                                     name="attn")(normed, positions, mask,
@@ -515,8 +530,18 @@ class Transformer(nn.Module):
 
     def setup(self):
         cfg = self.config
-        self.embed_tokens = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+        embed_dim = cfg.embed_proj_dim or cfg.hidden_size
+        self.embed_tokens = nn.Embed(cfg.vocab_size, embed_dim,
                                      param_dtype=jnp.float32, name="embed_tokens")
+        if cfg.embed_proj_dim is not None:
+            self.project_in = nn.Dense(cfg.hidden_size, use_bias=False,
+                                       dtype=cfg.jnp_dtype,
+                                       param_dtype=jnp.float32,
+                                       name="project_in")
+            self.project_out = nn.Dense(cfg.embed_proj_dim, use_bias=False,
+                                        dtype=cfg.jnp_dtype,
+                                        param_dtype=jnp.float32,
+                                        name="project_out")
         if cfg.position_embedding == "learned":
             self.embed_positions = nn.Embed(cfg.max_seq_len, cfg.hidden_size,
                                             param_dtype=jnp.float32,
@@ -544,7 +569,8 @@ class Transformer(nn.Module):
         else:
             self.block_list = [block(cfg, layer_idx=i, name=f"layers_{i}")
                                for i in range(cfg.num_layers)]
-        self.final_norm = _norm(cfg, "final_norm")
+        if cfg.pre_layer_norm:
+            self.final_norm = _norm(cfg, "final_norm")
         if not cfg.tie_word_embeddings:
             self.lm_head = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias,
                                     dtype=cfg.jnp_dtype, param_dtype=jnp.float32,
@@ -556,6 +582,8 @@ class Transformer(nn.Module):
         B, S = input_ids.shape
         positions = start_pos + jnp.broadcast_to(jnp.arange(S), (B, S))
         x = self.embed_tokens(input_ids).astype(cfg.jnp_dtype)
+        if cfg.embed_proj_dim is not None:
+            x = self.project_in(x)
         if cfg.position_embedding == "learned":
             x = x + self.embed_positions(positions).astype(cfg.jnp_dtype)
         if cfg.embedding_norm:
@@ -574,12 +602,15 @@ class Transformer(nn.Module):
                 aux = aux + a
             new_cache = None if cache is None else \
                 jax.tree.map(lambda *cs: jnp.stack(cs), *new_layers)
-        h = self.final_norm(x).astype(cfg.jnp_dtype)
+        h = self.final_norm(x).astype(cfg.jnp_dtype) \
+            if cfg.pre_layer_norm else x
         if with_aux:
             return h, new_cache, aux
         return (h, new_cache) if cache is not None else h
 
     def _head(self, x):
+        if self.config.embed_proj_dim is not None:
+            x = self.project_out(x)
         if self.config.tie_word_embeddings:
             emb = self.embed_tokens.embedding.astype(self.config.jnp_dtype)
             return x @ emb.T
@@ -589,15 +620,28 @@ class Transformer(nn.Module):
         """Pure head closure over concrete weight arrays — safe to call
         inside ``jax.checkpoint``/``lax.map`` (a bound ``nn.Dense`` is not:
         flax modules cannot be invoked under raw jax transforms).  ``ref``
-        is any [..., S, h] activation; a zero-width slice through lm_head
-        forces its params to exist at init time with no compute."""
+        is any [..., S, h] activation; a zero-width slice through lm_head /
+        project_out forces their params to exist at init time with no
+        compute."""
         cfg = self.config
+        proj = None
+        if cfg.embed_proj_dim is not None:
+            self.project_out(ref[..., :0, :])
+            proj = jnp.asarray(
+                self.project_out.variables["params"]["kernel"], cfg.jnp_dtype)
         if cfg.tie_word_embeddings:
             W = self.embed_tokens.embedding.astype(cfg.jnp_dtype).T
+            if proj is not None:
+                W = proj @ W
             return lambda x: x @ W
-        self.lm_head(ref[..., :0, :])
+        # lm_head consumes project_out-width features when projected
+        head_ref = ref[..., :0, :] if proj is None \
+            else self.project_out(ref[..., :0, :])
+        self.lm_head(head_ref)
         p = self.lm_head.variables["params"]
         W = jnp.asarray(p["kernel"], cfg.jnp_dtype)
+        if proj is not None:
+            W = proj @ W
         if "bias" in p:
             b = jnp.asarray(p["bias"], cfg.jnp_dtype)
             return lambda x: x @ W + b

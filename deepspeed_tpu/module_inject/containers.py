@@ -20,11 +20,7 @@ class OPTPolicy(HFPolicy):
     model_types = ("opt",)
 
     def build_config(self, hf, **over):
-        if getattr(hf, "word_embed_proj_dim", hf.hidden_size) != hf.hidden_size:
-            raise NotImplementedError("OPT word_embed_proj_dim != hidden_size "
-                                      "(opt-350m) is not supported")
-        if not getattr(hf, "do_layer_norm_before", True):
-            raise NotImplementedError("OPT post-LN variant not supported")
+        proj = getattr(hf, "word_embed_proj_dim", hf.hidden_size)
         base = dict(
             vocab_size=hf.vocab_size,
             hidden_size=hf.hidden_size,
@@ -35,6 +31,10 @@ class OPTPolicy(HFPolicy):
             activation=ACT_MAP[hf.activation_function],
             position_embedding="learned",
             tie_word_embeddings=hf.tie_word_embeddings,
+            # opt-350m: embeddings in a 512-dim space with project_in/out,
+            # post-LN blocks, no final norm
+            embed_proj_dim=proj if proj != hf.hidden_size else None,
+            pre_layer_norm=getattr(hf, "do_layer_norm_before", True),
         )
         base.update(over)
         return TransformerConfig(**base)
@@ -45,7 +45,14 @@ class OPTPolicy(HFPolicy):
                # two offset rows so plain arange positions index correctly.
                "embed_positions/embedding":
                    _np(sd["model.decoder.embed_positions.weight"])[2:]}
-        out.update(self.norm(sd, "model.decoder.final_layer_norm", "final_norm"))
+        if cfg.pre_layer_norm:
+            out.update(self.norm(sd, "model.decoder.final_layer_norm",
+                                 "final_norm"))
+        if cfg.embed_proj_dim is not None:
+            out["project_in/kernel"] = linear_kernel(
+                sd["model.decoder.project_in.weight"])
+            out["project_out/kernel"] = linear_kernel(
+                sd["model.decoder.project_out.weight"])
         if not cfg.tie_word_embeddings:
             out["lm_head/kernel"] = linear_kernel(sd["lm_head.weight"])
         return out

@@ -208,3 +208,21 @@ def test_chunked_loss_unrolled_matches(monkeypatch):
     monkeypatch.setenv("DSTPU_LOSS_CHUNK_UNROLL", "1")
     b = float(chunked_cross_entropy_loss(h, labels, head, 4))
     np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_chunked_loss_untied_projected_head():
+    """embed_proj_dim + untied lm_head + chunked loss: the pure-closure head
+    must init lm_head at project_out width (regression for the _head_pure
+    width mismatch)."""
+    from deepspeed_tpu.models.transformer import Transformer, TransformerConfig
+    import jax, numpy as np
+    kw = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+              max_seq_len=16, dtype="float32", use_flash_attention=False,
+              remat=False, tie_word_embeddings=False, embed_proj_dim=16)
+    ids = np.random.default_rng(0).integers(0, 64, (2, 16)).astype(np.int32)
+    m_full = Transformer(TransformerConfig(**kw))
+    params = jax.jit(m_full.init)(jax.random.key(0), {"input_ids": ids})
+    m_chunk = Transformer(TransformerConfig(**kw, loss_seq_chunks=4))
+    l_full = float(m_full.apply(params, {"input_ids": ids}))
+    l_chunk = float(m_chunk.apply(params, {"input_ids": ids}))
+    np.testing.assert_allclose(l_chunk, l_full, rtol=1e-5)

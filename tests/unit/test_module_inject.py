@@ -259,3 +259,41 @@ def test_clip_text_encoder_parity():
         lambda p, i: model.apply(p, i, method=type(model).hidden_states))(
             params, jnp.asarray(ids)))
     np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_opt_350m_layout_parity():
+    """The REAL opt-350m layout (DeepSpeed-Chat's default actor):
+    word_embed_proj_dim != hidden_size (project_in/out) AND post-LN blocks
+    with no final norm — exact logit parity with HF."""
+    cfg = transformers.OPTConfig(
+        vocab_size=97, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, ffn_dim=64, max_position_embeddings=64,
+        word_embed_proj_dim=16, do_layer_norm_before=False)
+    hf = transformers.OPTForCausalLM(cfg).eval()
+    ids = np.random.default_rng(4).integers(0, 97, (2, 12)).astype(np.int32)
+    model, params = convert_hf_model(hf, use_flash_attention=False,
+                                     dtype="float32")
+    assert model.config.embed_proj_dim == 16
+    assert not model.config.pre_layer_norm
+    got = np.asarray(jax.jit(
+        lambda p, i: model.apply(p, i, method=type(model).logits))(params, ids))
+    np.testing.assert_allclose(got, hf_logits(hf, ids), atol=1e-4, rtol=1e-4)
+
+    # KV-cached decode matches the full forward (post-LN + projection)
+    from deepspeed_tpu.model_implementations import DeepSpeedTransformerInference
+    ds = DeepSpeedTransformerInference(model.config, params=params,
+                                       max_batch=2, max_seq_len=32)
+    prefill = ds.forward(ids[:, :6])
+    np.testing.assert_allclose(np.asarray(prefill), got[:, :6], atol=1e-3,
+                               rtol=1e-2)
+    step = ds.forward(ids[:, 6:7])
+    np.testing.assert_allclose(np.asarray(step), got[:, 6:7], atol=1e-3,
+                               rtol=1e-2)
+
+    # chunked loss path with projection (head folds project_out)
+    full = float(model.apply(params, {"input_ids": ids}))
+    import dataclasses
+    ccfg = dataclasses.replace(model.config, loss_seq_chunks=4)
+    from deepspeed_tpu.models.transformer import Transformer
+    chunked = float(Transformer(ccfg).apply(params, {"input_ids": ids}))
+    np.testing.assert_allclose(chunked, full, rtol=1e-5)

@@ -65,6 +65,18 @@ def lm_loss(logits, labels):
 
 def transformer_pipe(config: TransformerConfig, num_stages=None,
                      **pipe_kwargs) -> PipelineModule:
+    # the single-tensor pipe layers implement the pre-LN trunk only;
+    # reject configs they would silently mis-build
+    unsupported = [n for n, bad in (
+        ("pre_layer_norm=False", not config.pre_layer_norm),
+        ("embed_proj_dim", config.embed_proj_dim is not None),
+        ("moe_num_experts", config.moe_num_experts > 0),
+        ("attention_layers", config.attention_layers is not None),
+    ) if bad]
+    if unsupported:
+        raise NotImplementedError(
+            f"transformer_pipe does not support {unsupported}; use the "
+            "non-pipeline Transformer for these configs")
     layers = [LayerSpec(EmbedPipe, config)]
     layers += [LayerSpec(BlockPipe, config) for _ in range(config.num_layers)]
     layers += [LayerSpec(HeadPipe, config)]

@@ -625,27 +625,26 @@ class Transformer(nn.Module):
         compute."""
         cfg = self.config
         proj = None
+        head_ref = ref[..., :0, :]
         if cfg.embed_proj_dim is not None:
-            self.project_out(ref[..., :0, :])
+            # zero-width pass both forces project_out's params to exist and
+            # gives lm_head its (projected-width) init reference
+            head_ref = self.project_out(head_ref)
             proj = jnp.asarray(
                 self.project_out.variables["params"]["kernel"], cfg.jnp_dtype)
+        # keep the projection as a separate matmul: folding proj @ W would
+        # materialize a [hidden, vocab] weight and ~2x the head FLOPs
+        chain = (lambda x: x) if proj is None else (lambda x: x @ proj)
         if cfg.tie_word_embeddings:
             W = self.embed_tokens.embedding.astype(cfg.jnp_dtype).T
-            if proj is not None:
-                W = proj @ W
-            return lambda x: x @ W
-        # lm_head consumes project_out-width features when projected
-        head_ref = ref[..., :0, :] if proj is None \
-            else self.project_out(ref[..., :0, :])
+            return lambda x: chain(x) @ W
         self.lm_head(head_ref)
         p = self.lm_head.variables["params"]
         W = jnp.asarray(p["kernel"], cfg.jnp_dtype)
-        if proj is not None:
-            W = proj @ W
         if "bias" in p:
             b = jnp.asarray(p["bias"], cfg.jnp_dtype)
-            return lambda x: x @ W + b
-        return lambda x: x @ W
+            return lambda x: chain(x) @ W + b
+        return lambda x: chain(x) @ W
 
     def logits(self, input_ids, mask=None):
         return self._head(self.hidden_states(input_ids, mask, train=False))

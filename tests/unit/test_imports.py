@@ -1,0 +1,23 @@
+"""Import sweep: every module in the package must import cleanly (catches
+import-time breakage anywhere in the tree — the analog of the reference's
+pre-compile op check CI)."""
+
+import importlib
+import pkgutil
+
+import pytest
+
+import deepspeed_tpu
+
+
+def _all_modules():
+    mods = []
+    for m in pkgutil.walk_packages(deepspeed_tpu.__path__,
+                                   prefix="deepspeed_tpu."):
+        mods.append(m.name)
+    return mods
+
+
+@pytest.mark.parametrize("name", _all_modules())
+def test_module_imports(name):
+    importlib.import_module(name)

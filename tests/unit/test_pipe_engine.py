@@ -97,3 +97,17 @@ def test_train_schedule_wavefront():
     # last stage's first tick is idle (wavefront delay)
     assert inf[0] == []
     assert [type(c).__name__ for c in inf[1]] == ["RecvActivation", "ForwardPass"]
+
+
+def test_transformer_pipe_rejects_unsupported_configs():
+    """Pipe layers implement the pre-LN dense trunk only — configs they
+    would silently mis-build must raise loudly."""
+    from deepspeed_tpu.models.pipeline_transformer import transformer_pipe
+    from deepspeed_tpu.models.transformer import TransformerConfig
+    base = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                max_seq_len=16, dtype="float32", use_flash_attention=False)
+    for bad in (dict(pre_layer_norm=False),
+                dict(embed_proj_dim=16),
+                dict(moe_num_experts=4, scan_layers=False)):
+        with pytest.raises(NotImplementedError):
+            transformer_pipe(TransformerConfig(**base, **bad))

@@ -111,9 +111,17 @@ def load_megatron_model(checkpoint, num_heads=None, megatron_v2=True,
     from deepspeed_tpu.runtime.state_dict_factory import (get_sd_loader,
                                                           get_sd_loader_json)
 
-    if isinstance(checkpoint, dict) and "checkpoints" not in checkpoint \
-            and not isinstance(next(iter(checkpoint.values()), None), str):
-        sd = checkpoint                       # already-merged state dict
+    if isinstance(checkpoint, dict) and "checkpoints" not in checkpoint:
+        # a dict without a "checkpoints" key must be an already-merged state
+        # dict.  Real Megatron saves carry metadata siblings ('iteration',
+        # 'checkpoint_version', ...) next to the tensors — keep the array
+        # entries, drop the rest; reject only when nothing is an array.
+        sd = {k: v for k, v in checkpoint.items() if hasattr(v, "shape")}
+        if not sd:
+            raise ValueError(
+                "checkpoint dict is neither a checkpoint-description json "
+                "(no 'checkpoints' key) nor a merged state dict (no array "
+                f"values among keys: {list(checkpoint)[:5]})")
     else:
         if isinstance(checkpoint, (str, dict)):
             _, ckpt_list, version = get_sd_loader_json(checkpoint)

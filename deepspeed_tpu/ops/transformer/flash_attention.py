@@ -504,8 +504,7 @@ def flash_attention(q, k, v, causal=True, scale=None,
     # q.dtype (mantissa untouched; the chain rule through it restores dq's
     # scale automatically).  Other scales (D=128 → 2^-3.5) stay in-kernel
     # in f32 — pre-scaling bf16 q would round every logit.
-    frac = float(np.log2(scale))
-    if frac == round(frac):
+    if scale > 0 and float(np.log2(scale)).is_integer():
         qt = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)
         kernel_scale = 1.0
     else:

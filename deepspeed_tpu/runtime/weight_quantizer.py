@@ -49,19 +49,40 @@ def _is_qw(x):
     return isinstance(x, QuantizedWeight)
 
 
+# Matrices whose name matches any of these stay float: the reference's
+# WeightQuantization quantizes attention/MLP matrices, not embeddings or
+# the LM head, where groupwise int error costs disproportionate accuracy.
+# Matched token-anchored (like state_dict_factory._classify) so short
+# patterns never fire inside unrelated names.
+DEFAULT_SKIP_PATTERNS = ("embed", "embedding", "embeddings", "wte", "wpe",
+                         "lm_head")
+
+
 class WeightQuantization:
     """Groupwise weight quantizer (reference ``WeightQuantization``).
 
     ``quantize_tree`` converts every float leaf with ``ndim >= min_ndim``
-    (default: matrices — embeddings/kernels; biases/norms stay float) into a
+    (default: matrices; biases/norms stay float) into a
     :class:`QuantizedWeight`; ``dequantize_tree`` is its jit-friendly
-    inverse.
+    inverse.  Leaves whose tree path matches ``skip_patterns`` (embeddings,
+    LM head by default) are left unquantized; pass ``skip_patterns=()`` to
+    quantize everything.
     """
 
     def __init__(self, bits=8, group_size=64, symmetric=True, min_ndim=2,
-                 mlp_extra_grouping=False, mp_size=1):
+                 mlp_extra_grouping=False, mp_size=1,
+                 skip_patterns=DEFAULT_SKIP_PATTERNS):
         if bits not in (4, 8):
             raise ValueError(f"bits must be 4 or 8, got {bits}")
+        if group_size < 2:
+            raise ValueError(f"group_size must be >= 2, got {group_size}")
+        if group_size % 2:
+            # int4 nibble-packing needs even groups; keep scales honest by
+            # declaring the real granularity rather than silently drifting
+            logger.warning(
+                f"WeightQuantization: odd group_size {group_size} rounded up "
+                f"to {group_size + 1} (int4 nibble-packing needs even groups)")
+            group_size += 1
         if mlp_extra_grouping or mp_size != 1:
             logger.warning(
                 "WeightQuantization: mlp_extra_grouping/mp_size are accepted "
@@ -71,18 +92,25 @@ class WeightQuantization:
         self.group_size = group_size
         self.symmetric = symmetric
         self.min_ndim = min_ndim
+        self.skip_patterns = tuple(p.lower() for p in skip_patterns)
 
     def should_quantize(self, leaf):
         return hasattr(leaf, "ndim") and leaf.ndim >= self.min_ndim and \
             jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
 
+    def _name_skipped(self, name):
+        import re
+        low = name.lower()
+        return any(re.search(rf"(^|[^a-z0-9]){re.escape(p)}([^a-z0-9]|$)",
+                             low)
+                   for p in self.skip_patterns)
+
     def quantize_leaf(self, leaf):
         x = jnp.asarray(leaf)
         # pad the flat vector to a multiple of group_size: every tensor gets
         # the CONFIGURED group granularity (prime/awkward sizes must not
-        # collapse to one whole-tensor scale), and the group width stays
-        # even so int4 always nibble-packs
-        gsz = max(2, self.group_size + (self.group_size % 2))
+        # collapse to one whole-tensor scale)
+        gsz = self.group_size
         pad = (-x.size) % gsz
         flat = jnp.pad(x.reshape(-1), (0, pad))
         groups = flat.size // gsz
@@ -102,16 +130,20 @@ class WeightQuantization:
         return flat.reshape(-1)[:numel].reshape(qw.shape).astype(dtype)
 
     def quantize_tree(self, params):
-        n_q = [0]
+        n_q, n_skip = [0], [0]
 
-        def one(leaf):
-            if self.should_quantize(leaf):
-                n_q[0] += 1
-                return self.quantize_leaf(leaf)
-            return leaf
-        out = jax.tree.map(one, params)
+        def one(path, leaf):
+            if not self.should_quantize(leaf):
+                return leaf
+            if self._name_skipped(jax.tree_util.keystr(path)):
+                n_skip[0] += 1
+                return leaf
+            n_q[0] += 1
+            return self.quantize_leaf(leaf)
+        out = jax.tree_util.tree_map_with_path(one, params)
         logger.info(f"weight-quantized {n_q[0]} tensors to int{self.bits} "
-                    f"(group {self.group_size})")
+                    f"(group {self.group_size}); {n_skip[0]} matrices kept "
+                    f"float by name filter {self.skip_patterns}")
         return out
 
     def dequantize_tree(self, params, dtype=jnp.bfloat16):
@@ -121,5 +153,7 @@ class WeightQuantization:
 
     # reference-API sugar: quantize a flat state-dict's matrices in place
     def model_quantize(self, sd):
-        return {k: (self.quantize_leaf(v) if self.should_quantize(v) else v)
+        return {k: (self.quantize_leaf(v)
+                    if self.should_quantize(v) and not self._name_skipped(k)
+                    else v)
                 for k, v in sd.items()}

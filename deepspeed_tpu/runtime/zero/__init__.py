@@ -85,7 +85,7 @@ class Init:
             return init_fn(rng, *args, **kwargs)
         from deepspeed_tpu.parallel.topology import get_topology
         topo = get_topology()
-        if self._mesh is not None and self._mesh is not topo.mesh:
+        if self._mesh is not None and self._mesh != topo.mesh:
             raise ValueError(
                 "zero.Init(mesh=...) differs from the live topology's mesh — "
                 "shardings are built on the global topology; call "

@@ -1,84 +1,215 @@
-"""Pipelined transformer — the ``GPT2ModelPipe`` pattern for this framework:
-builds a ``PipelineModule`` from a ``TransformerConfig`` with single-tensor
-layers (embed → blocks → norm+head) so the pipeline engine can split
-pre/body/post and stack the uniform trunk."""
+"""Pipelined transformer — the ``GPT2ModelPipe`` pattern for this framework
+(reference ``runtime/pipe/module.py:85,353,406-427``): builds a
+``PipelineModule`` from a ``TransformerConfig``.
+
+Layer decomposition:
+
+* ``EmbedPipe``  — token (+ learned position) embeddings, OPT-350M
+  ``project_in``, Bloom ``embedding_norm``;
+* ``BlockGroupPipe`` — ``group_size`` consecutive REAL ``Block``s from
+  ``models/transformer.py`` (so post-LN, parallel residual, per-layer
+  attention configs and MoE all behave exactly like the dense model).  The
+  group size is the smallest period of any per-layer heterogeneity
+  (``moe_every``, ``attention_layers`` pattern), making every group's param
+  structure identical — the uniform trunk the SPMD pipeline stacks;
+* ``HeadPipe`` / ``NormProjPipe`` + tied head — final norm (pre-LN only),
+  OPT-350M ``project_out``, LM head.  ``tie_word_embeddings`` uses
+  ``TiedLayerSpec`` (reference ``pipe/module.py:76``): the head re-uses
+  ``EmbedPipe``'s parameters via ``forward_fn``.
+
+MoE trunks thread the load-balancing aux loss through the pipeline as part
+of the activation pytree ``(hidden, aux)``.
+"""
+
+import math
 
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
-from deepspeed_tpu.models.transformer import (TransformerConfig, Attention, MLP,
+from deepspeed_tpu.models.transformer import (TransformerConfig, Block,
                                               _norm, cross_entropy_loss)
-from deepspeed_tpu.runtime.pipe.module import PipelineModule, LayerSpec
+from deepspeed_tpu.runtime.pipe.module import (PipelineModule, LayerSpec,
+                                               TiedLayerSpec)
 
 
 class EmbedPipe(nn.Module):
+    """ids → hidden activations, mirroring ``Transformer.hidden_states``'s
+    embedding prologue (``models/transformer.py``)."""
     config: TransformerConfig
+    carry_aux: bool = False
 
     @nn.compact
     def __call__(self, input_ids):
         cfg = self.config
-        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, param_dtype=jnp.float32,
-                     name="embed_tokens")(input_ids)
+        embed_dim = cfg.embed_proj_dim or cfg.hidden_size
+        x = nn.Embed(cfg.vocab_size, embed_dim, param_dtype=jnp.float32,
+                     name="embed_tokens")(input_ids).astype(cfg.jnp_dtype)
+        if cfg.embed_proj_dim is not None:
+            x = nn.Dense(cfg.hidden_size, use_bias=False, dtype=cfg.jnp_dtype,
+                         param_dtype=jnp.float32, name="project_in")(x)
         if cfg.position_embedding == "learned":
             B, S = input_ids.shape
             pos = jnp.broadcast_to(jnp.arange(S), (B, S))
             x = x + nn.Embed(cfg.max_seq_len, cfg.hidden_size,
                              param_dtype=jnp.float32,
-                             name="embed_positions")(pos)
-        return x.astype(cfg.jnp_dtype)
-
-
-class BlockPipe(nn.Module):
-    """Single-tensor transformer block: positions recomputed from shape
-    (the pipeline passes activations only, reference ``pipe/module.py``
-    layers are single-tensor too)."""
-    config: TransformerConfig
-
-    @nn.compact
-    def __call__(self, x):
-        cfg = self.config
-        B, S, _ = x.shape
-        positions = jnp.broadcast_to(jnp.arange(S), (B, S))
-        attn, _ = Attention(cfg, name="attn")(
-            _norm(cfg, "input_norm")(x).astype(cfg.jnp_dtype), positions, None)
-        x = x + attn
-        x = x + MLP(cfg, name="mlp")(
-            _norm(cfg, "post_attn_norm")(x).astype(cfg.jnp_dtype))
+                             name="embed_positions")(pos).astype(cfg.jnp_dtype)
+        if cfg.embedding_norm:
+            x = _norm(cfg, "embed_norm")(x)
+        x = x.astype(cfg.jnp_dtype)
+        if self.carry_aux:
+            return x, jnp.zeros((), jnp.float32)
         return x
 
 
-class HeadPipe(nn.Module):
+class BlockGroupPipe(nn.Module):
+    """``group_size`` consecutive dense-model ``Block``s as one pipe layer.
+
+    Positions are recomputed from shape (the pipeline passes activations
+    only; reference ``pipe/module.py`` layers are single-tensor too).
+    ``layer_idx`` is group-relative — valid because the group size is a
+    multiple of every per-layer pattern period (asserted in
+    ``transformer_pipe``)."""
     config: TransformerConfig
+    group_size: int = 1
+    carry_aux: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, xa, train=True):
         cfg = self.config
-        x = _norm(cfg, "final_norm")(x).astype(cfg.jnp_dtype)
-        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.jnp_dtype,
-                        param_dtype=jnp.float32, name="lm_head")(x)
+        if self.carry_aux:
+            x, aux = xa
+        else:
+            x, aux = xa, None
+        B, S, _ = x.shape
+        positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+        for j in range(self.group_size):
+            blk = Block(cfg, layer_idx=j, name=f"layers_{j}")
+            # train selects the MoE gate's capacity/noise regime (the dense
+            # Transformer passes it the same way)
+            x, _, a = blk(x, positions, None, None, train)
+            if aux is not None:
+                aux = aux + a
+        return (x, aux) if self.carry_aux else x
 
 
-def lm_loss(logits, labels):
-    return cross_entropy_loss(logits, labels)
+class HeadPipe(nn.Module):
+    """final-norm (pre-LN) → project_out (OPT-350M) → LM head."""
+    config: TransformerConfig
+    carry_aux: bool = False
+
+    @nn.compact
+    def __call__(self, xa):
+        cfg = self.config
+        x, aux = xa if self.carry_aux else (xa, None)
+        if cfg.pre_layer_norm:
+            x = _norm(cfg, "final_norm")(x).astype(cfg.jnp_dtype)
+        if cfg.embed_proj_dim is not None:
+            x = nn.Dense(cfg.embed_proj_dim, use_bias=False,
+                         dtype=cfg.jnp_dtype, param_dtype=jnp.float32,
+                         name="project_out")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias,
+                          dtype=cfg.jnp_dtype, param_dtype=jnp.float32,
+                          name="lm_head")(x)
+        return (logits, aux) if self.carry_aux else logits
+
+
+class NormProjPipe(nn.Module):
+    """The head's own-parameter prefix when the LM head itself is tied to
+    the embedding: final norm + OPT-style down-projection."""
+    config: TransformerConfig
+    carry_aux: bool = False
+
+    @nn.compact
+    def __call__(self, xa):
+        cfg = self.config
+        x, aux = xa if self.carry_aux else (xa, None)
+        if cfg.pre_layer_norm:
+            x = _norm(cfg, "final_norm")(x).astype(cfg.jnp_dtype)
+        if cfg.embed_proj_dim is not None:
+            x = nn.Dense(cfg.embed_proj_dim, use_bias=False,
+                         dtype=cfg.jnp_dtype, param_dtype=jnp.float32,
+                         name="project_out")(x)
+        return (x, aux) if self.carry_aux else x
+
+
+def _tied_head_fn(config: TransformerConfig, carry_aux: bool):
+    """``forward_fn`` for the tied LM head: logits = x @ embed.T using
+    EmbedPipe's parameters (reference tied-weight sync,
+    ``pipe/module.py:406-427`` — here GSPMD owns the single copy, so no
+    cross-stage allreduce exists to begin with)."""
+
+    def fwd(params, xa):
+        x, aux = xa if carry_aux else (xa, None)
+        W = jnp.asarray(params["params"]["embed_tokens"]["embedding"],
+                        config.jnp_dtype)
+        logits = x @ W.T
+        return (logits, aux) if carry_aux else logits
+
+    return fwd
+
+
+def _pattern_period(pattern):
+    """Smallest p dividing len(pattern) with pattern[i] == pattern[i % p]."""
+    n = len(pattern)
+    for p in range(1, n + 1):
+        if n % p == 0 and all(pattern[i] == pattern[i % p] for i in range(n)):
+            return p
+    return n
+
+
+def _infer_group_size(cfg: TransformerConfig) -> int:
+    """Layers per BlockGroupPipe: the lcm of every per-layer pattern period,
+    so group-relative ``layer_idx`` reproduces the absolute pattern."""
+    g = 1
+    if cfg.moe_num_experts > 0:
+        g = math.lcm(g, cfg.moe_every)
+    if cfg.attention_layers is not None:
+        g = math.lcm(g, _pattern_period(tuple(cfg.attention_layers)))
+    if cfg.num_layers % g != 0:
+        raise ValueError(
+            f"num_layers={cfg.num_layers} is not divisible by the per-layer "
+            f"pattern period {g} (moe_every={cfg.moe_every}, "
+            f"attention_layers period) — the pipeline trunk cannot be "
+            f"stacked uniformly")
+    return g
+
+
+def make_lm_loss(config: TransformerConfig):
+    carry_aux = config.moe_num_experts > 0
+
+    def lm_loss(out, labels):
+        if carry_aux:
+            logits, aux = out
+            return cross_entropy_loss(logits, labels) \
+                + config.moe_aux_coef * aux
+        return cross_entropy_loss(out, labels)
+
+    return lm_loss
 
 
 def transformer_pipe(config: TransformerConfig, num_stages=None,
                      **pipe_kwargs) -> PipelineModule:
-    # the single-tensor pipe layers implement the pre-LN trunk only;
-    # reject configs they would silently mis-build
-    unsupported = [n for n, bad in (
-        ("pre_layer_norm=False", not config.pre_layer_norm),
-        ("embed_proj_dim", config.embed_proj_dim is not None),
-        ("moe_num_experts", config.moe_num_experts > 0),
-        ("attention_layers", config.attention_layers is not None),
-    ) if bad]
-    if unsupported:
-        raise NotImplementedError(
-            f"transformer_pipe does not support {unsupported}; use the "
-            "non-pipeline Transformer for these configs")
-    layers = [LayerSpec(EmbedPipe, config)]
-    layers += [LayerSpec(BlockPipe, config) for _ in range(config.num_layers)]
-    layers += [LayerSpec(HeadPipe, config)]
-    return PipelineModule(layers, num_stages=num_stages, loss_fn=lm_loss,
-                          **pipe_kwargs)
+    """Build a PipelineModule for any ``TransformerConfig`` trunk: pre-LN
+    and post-LN (OPT-350M), embed projection, MoE (aux loss threaded through
+    the activation), per-layer attention patterns, tied embeddings."""
+    carry_aux = config.moe_num_experts > 0
+    group = _infer_group_size(config)
+    n_groups = config.num_layers // group
+
+    if config.tie_word_embeddings:
+        layers = [TiedLayerSpec("embed", EmbedPipe, config,
+                                carry_aux=carry_aux)]
+    else:
+        layers = [LayerSpec(EmbedPipe, config, carry_aux=carry_aux)]
+    layers += [LayerSpec(BlockGroupPipe, config, group_size=group,
+                         carry_aux=carry_aux) for _ in range(n_groups)]
+    if config.tie_word_embeddings:
+        layers += [LayerSpec(NormProjPipe, config, carry_aux=carry_aux),
+                   TiedLayerSpec("embed", EmbedPipe, config,
+                                 carry_aux=carry_aux,
+                                 forward_fn=_tied_head_fn(config, carry_aux))]
+    else:
+        layers += [LayerSpec(HeadPipe, config, carry_aux=carry_aux)]
+    return PipelineModule(layers, num_stages=num_stages,
+                          loss_fn=make_lm_loss(config), **pipe_kwargs)

@@ -1,13 +1,13 @@
 """SPMD pipeline parallelism over the ``pp`` mesh axis.
 
 TPU-native re-design of the reference pipeline engine
-(``runtime/pipe/engine.py:42``, ``schedule.py:189`` 1F1B, ``p2p.py:50,71``).
+(``runtime/pipe/engine.py:42``, ``schedule.py:135,189``, ``p2p.py:50,71``).
 The reference interprets an instruction schedule per-rank and exchanges
 activations with NCCL point-to-point sends.  Under single-controller SPMD the
 whole schedule becomes ONE differentiable program:
 
 * stages are shards of the ``pp`` axis inside ``shard_map`` (manual over
-  ``pp`` only — dp/tp/sp stay GSPMD-automatic);
+  ``pp`` only — dp/tp/sp/ep stay GSPMD-automatic);
 * the schedule is a ``lax.scan`` over ticks; stage *s* works on microbatch
   ``m = t - s`` (the classic pipeline wavefront);
 * activation transfer is one ``lax.ppermute`` per tick riding ICI neighbors
@@ -18,15 +18,24 @@ whole schedule becomes ONE differentiable program:
   stash).  ``jax.checkpoint`` on the stage body gives the same memory
   behavior as its activation-checkpointed stages.
 
-The dead-time fraction is the standard bubble ``(P-1)/(M+P-1)`` — identical
-to GPipe/1F1B fill-drain; XLA overlaps the ppermute with compute.
-"""
+Schedule honesty: this is a **fill-drain (GPipe) schedule** — all M
+microbatches flow forward, then backward.  Its bubble fraction,
+``(P-1)/(M+P-1)``, matches 1F1B, but its activation stash grows with M
+where the reference's ``TrainSchedule`` (1F1B, ``schedule.py:189``) bounds
+in-flight microbatches to ~P.  The 1F1B-class memory bound is provided by
+the engine's chunked accumulation (``pipeline.max_in_flight_microbatches``):
+chunks of C microbatches are differentiated one at a time, so at most C
+stage inputs are ever stashed, at the cost of a per-chunk bubble
+``(P-1)/(C+P-1)``.
 
-import functools
+Activations may be arbitrary pytrees (e.g. ``(hidden, aux_loss)`` for MoE
+trunks); every per-tick primitive is tree-mapped.
+"""
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.parallel.topology import PP_AXIS
@@ -37,9 +46,10 @@ def spmd_pipeline(stage_fn, stacked_params, x0, num_micro, mesh,
     """Run the pipelined forward: returns last-stage outputs ``[M, ...]``.
 
     ``stage_fn(stage_params, x) -> y`` maps one stage over one microbatch
-    activation (same shape in/out).  ``stacked_params`` leaves have leading
-    dim P (one slice per stage).  ``x0``: ``[M, ...]`` microbatch activations
-    entering stage 0.  Fully differentiable.
+    activation (a pytree; same structure/shapes in and out).
+    ``stacked_params`` leaves have leading dim P (one slice per stage).
+    ``x0``: pytree of ``[M, ...]`` microbatch activations entering stage 0.
+    Fully differentiable.
     """
     n_stages = mesh.shape[pp_axis]
     if remat_stage:
@@ -49,40 +59,53 @@ def spmd_pipeline(stage_fn, stacked_params, x0, num_micro, mesh,
     # all-reduces, which the region's backward emits for the replicated x0
     # cotangent.  Run the region in f32 on CPU; TPU stays bf16.
     cast_back = None
-    if jax.default_backend() == "cpu" and x0.dtype == jnp.bfloat16:
-        cast_back = x0.dtype
-        x0 = x0.astype(jnp.float32)
+    if jax.default_backend() == "cpu" and any(
+            l.dtype == jnp.bfloat16 for l in jax.tree.leaves(x0)):
+        orig_dtypes = jax.tree.map(lambda l: l.dtype, x0)
+        cast_back = orig_dtypes
+        up = lambda t: jax.tree.map(
+            lambda l: l.astype(jnp.float32)
+            if l.dtype == jnp.bfloat16 else l, t)
+        down = lambda t: jax.tree.map(
+            lambda l, d: l.astype(d), t, orig_dtypes)
         inner_stage_fn = stage_fn
-        stage_fn = lambda p, x: inner_stage_fn(p, x.astype(jnp.bfloat16)).astype(jnp.float32)
+        stage_fn = lambda p, x: up(inner_stage_fn(p, down(x)))
+        x0 = up(x0)
 
     def region(params, x0):
         sid = lax.axis_index(pp_axis)
         M = num_micro
         T = M + n_stages - 1
         params_local = jax.tree.map(lambda a: jnp.squeeze(a, 0), params)
-        state0 = jnp.zeros_like(x0[0])
+        state0 = jax.tree.map(lambda l: jnp.zeros_like(l[0]), x0)
 
         fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
 
         def tick(state, t):
             # receive previous stage's activation (stage 0 receives zeros)
-            recv = lax.ppermute(state, pp_axis, fwd_perm) if n_stages > 1 else state
-            x_t = lax.dynamic_index_in_dim(x0, jnp.minimum(t, M - 1), 0,
-                                           keepdims=False)
-            inp = jnp.where(sid == 0, x_t, recv)
+            recv = jax.tree.map(
+                lambda l: lax.ppermute(l, pp_axis, fwd_perm),
+                state) if n_stages > 1 else state
+            x_t = jax.tree.map(
+                lambda l: lax.dynamic_index_in_dim(
+                    l, jnp.minimum(t, M - 1), 0, keepdims=False), x0)
+            inp = jax.tree.map(lambda a, b: jnp.where(sid == 0, a, b),
+                               x_t, recv)
             m = t - sid
             active = jnp.logical_and(m >= 0, m < M)
             y = stage_fn(params_local, inp)
-            y = jnp.where(active, y, jnp.zeros_like(y))
+            y = jax.tree.map(
+                lambda l: jnp.where(active, l, jnp.zeros_like(l)), y)
             # emit only the last stage's finished microbatches
-            out = jnp.where(jnp.logical_and(active, sid == n_stages - 1), y,
-                            jnp.zeros_like(y))
+            emit = jnp.logical_and(active, sid == n_stages - 1)
+            out = jax.tree.map(
+                lambda l: jnp.where(emit, l, jnp.zeros_like(l)), y)
             return y, out
 
         _, outs = lax.scan(tick, state0, jnp.arange(T))
         # outs[t] holds microbatch m = t-(P-1) on the last stage, zeros
         # elsewhere; psum over pp broadcasts last-stage values to all shards.
-        outs = outs[n_stages - 1:]
+        outs = jax.tree.map(lambda l: l[n_stages - 1:], outs)
         if n_stages > 1:
             outs = lax.psum(outs, pp_axis)
         return outs
@@ -92,7 +115,9 @@ def spmd_pipeline(stage_fn, stacked_params, x0, num_micro, mesh,
         region, mesh=mesh, in_specs=in_specs, out_specs=P(),
         axis_names=frozenset({pp_axis}), check_vma=False,
     )(stacked_params, x0)
-    return out.astype(cast_back) if cast_back is not None else out
+    if cast_back is not None:
+        out = jax.tree.map(lambda l, d: l.astype(d), out, cast_back)
+    return out  # structure matches x0 (stage in == stage out)
 
 
 def pipeline_bubble_fraction(num_micro, num_stages):

@@ -180,6 +180,11 @@ class PipelineConfig(DeepSpeedConfigModel):
     micro_batches: Optional[int] = None
     partition_method: str = "parameters"
     activation_checkpoint_interval: int = 0
+    # 1F1B-class memory bound (reference TrainSchedule, schedule.py:189,
+    # bounds in-flight microbatches to ~stages): differentiate chunks of
+    # this many microbatches at a time, so at most this many stage inputs
+    # are ever stashed.  0 = unbounded fill-drain (lowest bubble).
+    max_in_flight_microbatches: int = 0
 
 
 class SequenceParallelConfig(DeepSpeedConfigModel):

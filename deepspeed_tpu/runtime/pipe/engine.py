@@ -65,6 +65,12 @@ class PipelineEngine(DeepSpeedEngine):
         if self.num_stages < 1:
             raise ValueError("pipeline requires pp >= 1 in the mesh")
         self.micro_batches = self.gradient_accumulation_steps()
+        C = int(self._config.pipeline.max_in_flight_microbatches or 0)
+        if C and self.micro_batches % C != 0:
+            raise ValueError(
+                f"pipeline.max_in_flight_microbatches={C} must divide "
+                f"micro_batches={self.micro_batches}")
+        self.max_in_flight = C
 
     # the reference forbids forward/backward/step on the pipeline engine —
     # train_batch is the unit of work (pipe/engine.py:1107-1118)
@@ -89,36 +95,75 @@ class PipelineEngine(DeepSpeedEngine):
         self._raw_apply = None   # pipeline path doesn't use the base apply
 
     def _layer_params_and_apply(self, layer, rng, x_abs):
-        """Init one layer against the incoming abstract activation."""
+        """Init one layer against the incoming abstract activation.
+
+        Every returned apply has the uniform signature
+        ``apply(params, x, train=True)``; the flag is forwarded only to
+        modules whose ``__call__`` declares it (MoE gates switch their
+        capacity/noise regime on it, like the dense Transformer)."""
+        import inspect
         import flax.linen as nn
         if isinstance(layer, nn.Module):
             params = layer.init(rng, _zeros_like_abs(x_abs))
-            apply = layer.apply
-            y_abs = jax.eval_shape(lambda p, x: layer.apply(p, x), params, x_abs)
+            takes_train = "train" in inspect.signature(
+                type(layer).__call__).parameters
+            if takes_train:
+                apply = lambda p, x, train=True: layer.apply(p, x, train=train)
+            else:
+                apply = lambda p, x, train=True: layer.apply(p, x)
+            y_abs = jax.eval_shape(lambda p, x: apply(p, x), params, x_abs)
             return params, apply, y_abs
         # paramless callable
         y_abs = jax.eval_shape(layer, x_abs)
-        return None, (lambda p, x: layer(x)), y_abs
+        return None, (lambda p, x, train=True: layer(x)), y_abs
 
     def _build_pipeline(self, example_micro):
-        """Initialize all layers, split pre/body/post, stack body."""
+        """Initialize all layers, split pre/body/post, stack body.
+
+        ``TiedLayerSpec`` layers sharing a key share parameters (reference
+        ``pipe/module.py:76,406-427``): the second occurrence initializes
+        nothing and applies its ``forward_fn`` (or the module's apply) to
+        the FIRST occurrence's params — the single GSPMD copy makes the
+        reference's tied-grad allreduce unnecessary.  Tied layers must sit
+        outside the stacked body (pre/post), which embedding/head tying
+        always satisfies."""
+        from deepspeed_tpu.runtime.pipe.module import TiedLayerSpec
         layers = self.pipe_module.build_layers()
+        specs = self.pipe_module.layer_specs
         rng = jax.random.key(self._config.seed)
         x_abs = jax.eval_shape(lambda b: _first_tensor(b), example_micro)
-        inits, applies, structs = [], [], []
-        for i, layer in enumerate(layers):
+        inits, applies, structs, reuse_of = [], [], [], []
+        tied_first = {}
+        for i, (spec, layer) in enumerate(zip(specs, layers)):
+            tied_key = spec.key if isinstance(spec, TiedLayerSpec) else None
+            if tied_key is not None and tied_key in tied_first:
+                src = tied_first[tied_key]
+                raw = spec.forward_fn or \
+                    (lambda p, x, _l=layer: _l.apply(p, x))
+                fwd = lambda p, x, train=True, _raw=raw: _raw(p, x)
+                x_abs = jax.eval_shape(lambda p, x: fwd(p, x),
+                                       inits[src], x_abs)
+                inits.append(None)
+                applies.append(fwd)
+                structs.append(None)
+                reuse_of.append(src)
+                continue
+            if tied_key is not None:
+                tied_first[tied_key] = i
             rng, sub = jax.random.split(rng)
             params, apply, x_abs = self._layer_params_and_apply(layer, sub, x_abs)
             inits.append(params)
             applies.append(apply)
             structs.append(jax.tree.structure(params)
                            if params is not None else None)
+            reuse_of.append(None)
         # majority structure = the pipeline body; the run must be contiguous
         # (stacked SPMD stages execute one uniform layer function)
         from collections import Counter
         counted = Counter(s for s in structs if s is not None)
         body_struct, body_count = counted.most_common(1)[0]
-        idxs = [i for i, s in enumerate(structs) if s == body_struct]
+        idxs = [i for i, s in enumerate(structs)
+                if s is not None and s == body_struct]
         first, last = idxs[0], idxs[-1]
         if last - first + 1 != body_count:
             gaps = [i for i in range(first, last + 1) if structs[i] != body_struct]
@@ -135,8 +180,20 @@ class PipelineEngine(DeepSpeedEngine):
             raise ValueError(
                 f"{body_count} pipeline body layers not divisible by "
                 f"pp={self.topology.pp} stages")
-        self._pre = [(applies[i], inits[i]) for i in range(first)]
-        self._post = [(applies[i], inits[i]) for i in range(last + 1, len(layers))]
+        tied_sources = {r for r in reuse_of if r is not None}
+        if any(reuse_of[i] is not None for i in range(first, last + 1)) or \
+                any(first <= s <= last for s in tied_sources):
+            raise ValueError(
+                "TiedLayerSpec sharing with the pipeline body is "
+                "unsupported (neither occurrence may fall in the stacked "
+                "trunk); tie embedding/head layers (pre/post) only")
+
+        def outer_entry(i):
+            return {"apply": applies[i], "params": inits[i],
+                    "layer_idx": i, "reuse_of": reuse_of[i]}
+
+        self._pre = [outer_entry(i) for i in range(first)]
+        self._post = [outer_entry(i) for i in range(last + 1, len(layers))]
         self._body_apply = applies[first]
         body_params = [inits[i] for i in range(first, last + 1)]
         self._body_stacked = stack_stage_params(body_params, self.topology.pp)
@@ -146,9 +203,9 @@ class PipelineEngine(DeepSpeedEngine):
 
     def _assemble_params(self):
         return {
-            "pre": [p for _, p in self._pre if p is not None],
+            "pre": [e["params"] for e in self._pre if e["params"] is not None],
             "body": self._body_stacked,
-            "post": [p for _, p in self._post if p is not None],
+            "post": [e["params"] for e in self._post if e["params"] is not None],
         }
 
     def _build_pipe_plan(self, abstract):
@@ -201,24 +258,37 @@ class PipelineEngine(DeepSpeedEngine):
         self._init_opt_state()
 
     # ------------------------------------------------------------------ #
-    def _pipe_loss(self, params, batch, rng):
+    def _pipe_loss(self, params, batch, rng, num_micro=None, train=True):
         """The full pipelined loss: pre → spmd_pipeline → post → loss_fn.
 
         ``batch``: pytree with leading [M, mb, ...]; convention (inputs,
-        labels) tuple or dict with 'labels'.
+        labels) tuple or dict with 'labels'.  Activations may be pytrees
+        (MoE trunks carry ``(hidden, aux)``).  Tied layers resolve their
+        shared params from the first occurrence (``seen``).
         """
         inputs, labels = _split_batch(batch)
-        M = self.micro_batches
+        M = num_micro if num_micro is not None else self.micro_batches
         cast = lambda t: jax.tree.map(
             lambda p: p.astype(self.compute_dtype)
             if jnp.issubdtype(p.dtype, jnp.floating) else p, t)
         pre_ps = iter(cast(params["pre"]))
         post_ps = iter(cast(params["post"]))
+        seen = {}
 
-        x = inputs
-        for apply, p0 in self._pre:
-            p = next(pre_ps) if p0 is not None else None
-            x = jax.vmap(lambda xm: apply(p, xm))(x)
+        def run_outer(entries, ps, x):
+            for e in entries:
+                if e["reuse_of"] is not None:
+                    p = seen[e["reuse_of"]]
+                elif e["params"] is not None:
+                    p = next(ps)
+                    seen[e["layer_idx"]] = p
+                else:
+                    p = None
+                apply = e["apply"]
+                x = jax.vmap(lambda xm: apply(p, xm, train=train))(x)
+            return x
+
+        x = run_outer(self._pre, pre_ps, inputs)
 
         body = cast(params["body"])
         layer_apply = self._body_apply
@@ -226,15 +296,12 @@ class PipelineEngine(DeepSpeedEngine):
         def stage_fn(stage_params, xm):
             # one stage = scan over its L/P layers
             def one(h, p):
-                return layer_apply(p, h), None
+                return layer_apply(p, h, train=train), None
             out, _ = jax.lax.scan(one, xm, stage_params)
             return out
 
         ys = spmd_pipeline(stage_fn, body, x, M, self.mesh)
-        out = ys
-        for apply, p0 in self._post:
-            p = next(post_ps) if p0 is not None else None
-            out = jax.vmap(lambda xm: apply(p, xm))(out)
+        out = run_outer(self._post, post_ps, ys)
 
         loss_fn = self.pipe_module.loss_fn or _default_loss
         losses = jax.vmap(loss_fn)(out, labels)
@@ -247,10 +314,33 @@ class PipelineEngine(DeepSpeedEngine):
             scaler = self.loss_scaler
 
             def train_step(params, opt_state, scaler_state, lr, step, rng, batch):
-                def loss_of(p):
-                    return self._pipe_loss(p, batch, rng) * scaler_state.scale
+                M = self.micro_batches
+                C = self.max_in_flight
 
-                loss, grads = jax.value_and_grad(loss_of)(params)
+                def loss_of(p, b, n):
+                    return self._pipe_loss(p, b, rng, num_micro=n) \
+                        * scaler_state.scale
+
+                if C and C < M:
+                    # 1F1B-class memory bound: differentiate C microbatches
+                    # at a time so at most C stage inputs are stashed; the
+                    # scan accumulates grads chunk by chunk (reference
+                    # TrainSchedule's in-flight bound, schedule.py:189).
+                    n_chunks = M // C
+                    chunked = jax.tree.map(
+                        lambda l: l.reshape(n_chunks, C, *l.shape[1:]), batch)
+
+                    def one_chunk(gacc, cb):
+                        l, g = jax.value_and_grad(loss_of)(params, cb, C)
+                        return jax.tree.map(jnp.add, gacc, g), l
+
+                    zeros = jax.tree.map(
+                        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                    gsum, ls = jax.lax.scan(one_chunk, zeros, chunked)
+                    grads = jax.tree.map(lambda g: g / n_chunks, gsum)
+                    loss = jnp.mean(ls)
+                else:
+                    loss, grads = jax.value_and_grad(loss_of)(params, batch, M)
                 found_inf = jnp.logical_not(
                     jnp.all(jnp.stack([jnp.all(jnp.isfinite(g))
                                        for g in jax.tree.leaves(grads)])))
@@ -317,7 +407,7 @@ class PipelineEngine(DeepSpeedEngine):
         key = "eval_pipe"
         if key not in self._compiled:
             self._compiled[key] = jax.jit(
-                lambda p, b, r: self._pipe_loss(p, b, r))
+                lambda p, b, r: self._pipe_loss(p, b, r, train=False))
         self._rng, rng = jax.random.split(self._rng)
         return self._compiled[key](self._params, batch, rng)
 

@@ -10,6 +10,8 @@ fuses the dequant into each weight's consumer, so the compute-dtype copy of
 a layer's weights exists only transiently while that layer computes.
 """
 
+import re
+
 import numpy as np
 
 import jax
@@ -93,17 +95,20 @@ class WeightQuantization:
         self.symmetric = symmetric
         self.min_ndim = min_ndim
         self.skip_patterns = tuple(p.lower() for p in skip_patterns)
+        # token-anchored (like state_dict_factory._classify): short patterns
+        # must not fire inside unrelated names; precompiled once
+        self._skip_re = re.compile(
+            "|".join(rf"(?:^|[^a-z0-9]){re.escape(p)}(?:[^a-z0-9]|$)"
+                     for p in self.skip_patterns)) if self.skip_patterns \
+            else None
 
     def should_quantize(self, leaf):
         return hasattr(leaf, "ndim") and leaf.ndim >= self.min_ndim and \
             jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
 
     def _name_skipped(self, name):
-        import re
-        low = name.lower()
-        return any(re.search(rf"(^|[^a-z0-9]){re.escape(p)}([^a-z0-9]|$)",
-                             low)
-                   for p in self.skip_patterns)
+        return self._skip_re is not None and \
+            self._skip_re.search(name.lower()) is not None
 
     def quantize_leaf(self, leaf):
         x = jnp.asarray(leaf)

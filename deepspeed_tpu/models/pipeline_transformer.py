@@ -93,6 +93,19 @@ class BlockGroupPipe(nn.Module):
         return (x, aux) if self.carry_aux else x
 
 
+def _head_prefix(cfg, x):
+    """Shared head prologue — final norm (pre-LN only) + OPT-style
+    down-projection.  Submodules attach to the CALLING module (flax
+    compact), so tied and untied heads stay one implementation."""
+    if cfg.pre_layer_norm:
+        x = _norm(cfg, "final_norm")(x).astype(cfg.jnp_dtype)
+    if cfg.embed_proj_dim is not None:
+        x = nn.Dense(cfg.embed_proj_dim, use_bias=False,
+                     dtype=cfg.jnp_dtype, param_dtype=jnp.float32,
+                     name="project_out")(x)
+    return x
+
+
 class HeadPipe(nn.Module):
     """final-norm (pre-LN) → project_out (OPT-350M) → LM head."""
     config: TransformerConfig
@@ -102,12 +115,7 @@ class HeadPipe(nn.Module):
     def __call__(self, xa):
         cfg = self.config
         x, aux = xa if self.carry_aux else (xa, None)
-        if cfg.pre_layer_norm:
-            x = _norm(cfg, "final_norm")(x).astype(cfg.jnp_dtype)
-        if cfg.embed_proj_dim is not None:
-            x = nn.Dense(cfg.embed_proj_dim, use_bias=False,
-                         dtype=cfg.jnp_dtype, param_dtype=jnp.float32,
-                         name="project_out")(x)
+        x = _head_prefix(cfg, x)
         logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias,
                           dtype=cfg.jnp_dtype, param_dtype=jnp.float32,
                           name="lm_head")(x)
@@ -116,7 +124,7 @@ class HeadPipe(nn.Module):
 
 class NormProjPipe(nn.Module):
     """The head's own-parameter prefix when the LM head itself is tied to
-    the embedding: final norm + OPT-style down-projection."""
+    the embedding (the tied matmul follows as a TiedLayerSpec)."""
     config: TransformerConfig
     carry_aux: bool = False
 
@@ -124,12 +132,7 @@ class NormProjPipe(nn.Module):
     def __call__(self, xa):
         cfg = self.config
         x, aux = xa if self.carry_aux else (xa, None)
-        if cfg.pre_layer_norm:
-            x = _norm(cfg, "final_norm")(x).astype(cfg.jnp_dtype)
-        if cfg.embed_proj_dim is not None:
-            x = nn.Dense(cfg.embed_proj_dim, use_bias=False,
-                         dtype=cfg.jnp_dtype, param_dtype=jnp.float32,
-                         name="project_out")(x)
+        x = _head_prefix(cfg, x)
         return (x, aux) if self.carry_aux else x
 
 

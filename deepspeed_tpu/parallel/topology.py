@@ -25,17 +25,19 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 # Canonical axis names.
 PP_AXIS = "pp"      # pipeline stages
+MDP_AXIS = "mdp"    # MiCS replica groups (ZeRO shards live WITHIN a group,
+                    # replicate ACROSS this axis — reference mics.py:24-29)
 EDP_AXIS = "edp"    # expert-data-parallel (DP within an expert group)
 EP_AXIS = "ep"      # expert parallel
 SP_AXIS = "sp"      # sequence/context parallel
 TP_AXIS = "tp"      # tensor/model parallel
 
-AXIS_ORDER = (PP_AXIS, EDP_AXIS, EP_AXIS, SP_AXIS, TP_AXIS)
+AXIS_ORDER = (PP_AXIS, MDP_AXIS, EDP_AXIS, EP_AXIS, SP_AXIS, TP_AXIS)
 
 # Compound groups, named for parity with the reference group getters.
-DP_AXES = (EDP_AXIS, EP_AXIS)              # dense data-parallel group
-DENSE_GRAD_AXES = (EDP_AXIS, EP_AXIS, SP_AXIS)  # grad-reduction axes, dense params
-EXPERT_GRAD_AXES = (EDP_AXIS, SP_AXIS)          # grad-reduction axes, expert params
+DP_AXES = (MDP_AXIS, EDP_AXIS, EP_AXIS)    # dense data-parallel group
+DENSE_GRAD_AXES = (MDP_AXIS, EDP_AXIS, EP_AXIS, SP_AXIS)  # grad axes, dense
+EXPERT_GRAD_AXES = (MDP_AXIS, EDP_AXIS, SP_AXIS)          # grad axes, expert
 
 
 @dataclass
@@ -52,14 +54,16 @@ class ParallelTopology:
     pp: int = 1
     ep: int = 1
     sp: int = 1
+    mdp: int = 1
     devices: list = field(default=None, repr=False)
     mesh: Mesh = field(default=None, repr=False)
 
     def __post_init__(self):
-        if self.dp % self.ep != 0:
+        if self.dp % (self.ep * self.mdp) != 0:
             raise ValueError(
-                f"expert parallel size {self.ep} must divide data parallel size {self.dp}")
-        self.edp = self.dp // self.ep
+                f"expert parallel size {self.ep} x MiCS replica groups "
+                f"{self.mdp} must divide data parallel size {self.dp}")
+        self.edp = self.dp // (self.ep * self.mdp)
         devices = self.devices
         if devices is None:
             devices = jax.devices()
@@ -70,7 +74,7 @@ class ParallelTopology:
                 f"needs {need} devices, have {len(devices)}")
         devices = devices[:need]
         if self.mesh is None:
-            shape = (self.pp, self.edp, self.ep, self.sp, self.tp)
+            shape = (self.pp, self.mdp, self.edp, self.ep, self.sp, self.tp)
             try:
                 from jax.experimental import mesh_utils
                 dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
@@ -81,7 +85,7 @@ class ParallelTopology:
     # ------------------------------------------------------------------ #
     @property
     def world_size(self):
-        return self.pp * self.edp * self.ep * self.sp * self.tp
+        return self.pp * self.mdp * self.edp * self.ep * self.sp * self.tp
 
     # Group getters — parity with reference ``utils/groups.py:280-392``.
     def get_data_parallel_axes(self):
@@ -97,7 +101,9 @@ class ParallelTopology:
         return (EP_AXIS,)
 
     def get_expert_data_parallel_axes(self):
-        return (EDP_AXIS,)
+        # the DP replicas of one expert: the MiCS replica axis is part of
+        # the group, else expert grads would never reduce across groups
+        return (MDP_AXIS, EDP_AXIS)
 
     def get_sequence_parallel_axes(self):
         return (SP_AXIS,)
@@ -149,7 +155,13 @@ class ParallelTopology:
 _TOPOLOGY = None
 
 
-def initialize_topology(dp=None, tp=1, pp=1, ep=1, sp=1, devices=None):
+def initialize_topology(dp=None, tp=1, pp=1, ep=1, sp=1, mics=0,
+                        devices=None):
+    """``mics`` > 0 sizes the ZeRO shard group (reference
+    ``mics_shard_size``, ``runtime/zero/mics.py:54``): the DP world splits
+    into ``mdp`` replica groups of ``mics`` ZeRO-sharding devices each —
+    params/opt-state shard WITHIN a group (ICI-local gathers), replicate
+    ACROSS groups; grads still reduce over all of DP."""
     global _TOPOLOGY
     if devices is None:
         devices = jax.devices()
@@ -159,7 +171,16 @@ def initialize_topology(dp=None, tp=1, pp=1, ep=1, sp=1, devices=None):
             raise ValueError(
                 f"device count {len(devices)} not divisible by tp*pp*ep*sp={denom}")
         dp = (len(devices) // denom) * ep  # dp includes the ep sub-axis
-    _TOPOLOGY = ParallelTopology(dp=dp, tp=tp, pp=pp, ep=ep, sp=sp, devices=devices)
+    mdp = 1
+    if mics and mics > 0:
+        edp_world = dp // ep
+        if edp_world % mics != 0:
+            raise ValueError(
+                f"mics_shard_size={mics} must divide the expert-data-"
+                f"parallel world {edp_world} (dp={dp} / ep={ep})")
+        mdp = edp_world // mics
+    _TOPOLOGY = ParallelTopology(dp=dp, tp=tp, pp=pp, ep=ep, sp=sp, mdp=mdp,
+                                 devices=devices)
     return _TOPOLOGY
 
 

@@ -113,10 +113,12 @@ class DeepSpeedEngine:
         pp = raw.get("pipeline", {}).get("stages", 1) if isinstance(raw.get("pipeline"), dict) else 1
         sp = raw.get("sequence_parallel", {}).get("sp_size", 1)
         ep = raw.get("moe", {}).get("ep_size", 1)
+        mics = raw.get("zero_optimization", {}).get("mics_shard_size", 0)
         if topology is not None:
             self.topology = topo_mod.set_topology(topology)
         else:
-            self.topology = topo_mod.initialize_topology(tp=tp, pp=pp, sp=sp, ep=ep)
+            self.topology = topo_mod.initialize_topology(tp=tp, pp=pp, sp=sp,
+                                                         ep=ep, mics=mics)
         self.mesh = self.topology.mesh
 
         if config_class is not None:

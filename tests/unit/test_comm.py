@@ -10,7 +10,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 import deepspeed_tpu.comm as dist
-from deepspeed_tpu.parallel.topology import initialize_topology, DP_AXES
+from deepspeed_tpu.parallel.topology import (initialize_topology, DP_AXES,
+                                              EDP_AXIS)
 
 
 @pytest.fixture
@@ -79,7 +80,7 @@ def test_broadcast_in_mesh(topo):
 def test_ppermute_shift(topo):
     x = jnp.arange(8.0)
     out = _run_collective(
-        topo, lambda v: dist.send_recv_next(v, (DP_AXES[0],)),
+        topo, lambda v: dist.send_recv_next(v, (EDP_AXIS,)),
         x, P(DP_AXES), P(DP_AXES))
     np.testing.assert_allclose(np.asarray(out), np.roll(np.arange(8.0), 1))
 

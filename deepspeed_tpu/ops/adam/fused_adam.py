@@ -29,7 +29,7 @@ class FusedAdam:
 
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
                  adam_w_mode=True, bias_correction=True, amsgrad=False,
-                 master_dtype=jnp.float32):
+                 master_dtype=jnp.float32, state_dtype=None):
         if amsgrad:
             raise ValueError("FusedAdam does not support amsgrad (parity with reference)")
         self.lr = lr
@@ -38,10 +38,15 @@ class FusedAdam:
         self.weight_decay = weight_decay
         self.adam_w_mode = adam_w_mode
         self.bias_correction = bias_correction
-        self.master_dtype = master_dtype
+        self.master_dtype = jnp.dtype(master_dtype)
+        # moment STORAGE dtype (memory-lean option for chips whose HBM can't
+        # hold 8 bytes/param of fp32 moments; arithmetic stays master_dtype).
+        # Default = master_dtype → exact reference semantics.
+        self.state_dtype = jnp.dtype(state_dtype) if state_dtype is not None \
+            else self.master_dtype
 
     def init(self, params):
-        zeros = lambda p: jnp.zeros(p.shape, dtype=self.master_dtype)
+        zeros = lambda p: jnp.zeros(p.shape, dtype=self.state_dtype)
         return AdamState(exp_avg=jax.tree.map(zeros, params),
                          exp_avg_sq=jax.tree.map(zeros, params))
 
@@ -60,13 +65,14 @@ class FusedAdam:
             p32 = p.astype(self.master_dtype)
             if wd != 0.0 and not self.adam_w_mode:
                 g32 = g32 + wd * p32
-            m = b1 * m + (1.0 - b1) * g32
-            v = b2 * v + (1.0 - b2) * (g32 * g32)
+            m = b1 * m.astype(self.master_dtype) + (1.0 - b1) * g32
+            v = b2 * v.astype(self.master_dtype) + (1.0 - b2) * (g32 * g32)
             denom = jnp.sqrt(v / bc2) + eps
             upd = (m / bc1) / denom
             if wd != 0.0 and self.adam_w_mode:
                 upd = upd + wd * p32
-            return (p32 - lr * upd).astype(p.dtype), m, v
+            return ((p32 - lr * upd).astype(p.dtype),
+                    m.astype(self.state_dtype), v.astype(self.state_dtype))
 
         out = jax.tree.map(leaf, params, grads, state.exp_avg, state.exp_avg_sq)
         new_params = jax.tree.map(lambda t: t[0], out, is_leaf=lambda t: isinstance(t, tuple))

@@ -36,6 +36,12 @@ class FP16Config(DeepSpeedConfigModel):
 
 class BF16Config(DeepSpeedConfigModel):
     enabled: bool = False
+    # Memory-lean deviation (off by default): keep the persistent master
+    # params in bf16 instead of fp32, saving 4 bytes/param of HBM.  The
+    # optimizer still does its arithmetic in fp32.  Combine with the
+    # optimizer's ``state_dtype: bfloat16`` to fit models whose fp32
+    # master+moments (12 bytes/param) exceed a single chip's HBM.
+    master_weights_in_bf16: bool = False
 
 
 class OffloadDeviceEnum:

@@ -171,6 +171,17 @@ class DeepSpeedEngine:
             self.compute_dtype = jnp.bfloat16
         else:
             self.compute_dtype = jnp.float32
+        # persistent master-param storage dtype (fp32 unless the memory-lean
+        # bf16 master option is on; optimizer math stays fp32 either way)
+        self._master_dtype = jnp.bfloat16 \
+            if (self._config.bf16.enabled
+                and self._config.bf16.master_weights_in_bf16) else jnp.float32
+        if self._config.bf16.master_weights_in_bf16 \
+                and not self._config.bf16.enabled:
+            logger.warning(
+                "bf16.master_weights_in_bf16 is set but bf16.enabled is "
+                "false — masters stay fp32; the memory-lean mode requires "
+                "bf16 compute")
 
         accel = get_accelerator()
         accel.manual_seed(self._config.seed)
@@ -331,13 +342,13 @@ class DeepSpeedEngine:
         ``materialize_opt=False`` computes optimizer shardings only (the
         caller will install loaded state) — no fresh m/v allocation."""
         abstract = jax.eval_shape(lambda t: jax.tree.map(
-            lambda p: p.astype(jnp.float32)
+            lambda p: p.astype(self._master_dtype)
             if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else jnp.asarray(p),
             t), params)
         self._build_plan(abstract)
         put = jax.jit(
             lambda t: jax.tree.map(
-                lambda p: p.astype(jnp.float32)
+                lambda p: p.astype(self._master_dtype)
                 if jnp.issubdtype(p.dtype, jnp.floating) else p, t),
             out_shardings=self._plan.param_shardings)
         self._params = put(params)
@@ -394,12 +405,13 @@ class DeepSpeedEngine:
         abstract = jax.eval_shape(lambda r: self._init_fn(r, *args, **kwargs), init_rng)
         abstract = jax.tree.map(
             lambda s: jax.ShapeDtypeStruct(
-                s.shape, jnp.float32 if jnp.issubdtype(s.dtype, jnp.floating) else s.dtype),
+                s.shape, self._master_dtype
+                if jnp.issubdtype(s.dtype, jnp.floating) else s.dtype),
             abstract)
         self._build_plan(abstract)
         init_jit = jax.jit(
             lambda r, a, kw: jax.tree.map(
-                lambda p: p.astype(jnp.float32)
+                lambda p: p.astype(self._master_dtype)
                 if jnp.issubdtype(p.dtype, jnp.floating) else p,
                 self._init_fn(r, *a, **kw)),
             out_shardings=self._plan.param_shardings)

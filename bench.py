@@ -31,10 +31,9 @@ import numpy as np
 
 
 def _sync_scalar(x):
-    """Dependent-sync: through the axon tunnel block_until_ready can return
-    early; fetching a scalar derived from the output is the reliable fence."""
-    import jax
-    return float(np.asarray(jax.device_get(x)).reshape(-1)[0])
+    """Dependent-sync fence (see deepspeed_tpu.utils.sync)."""
+    from deepspeed_tpu.utils.sync import dependent_sync_scalar
+    return dependent_sync_scalar(x)
 
 
 def train_bench(model_name, *, micro_bs, zero_stage, steps, seq=2048,
@@ -126,10 +125,14 @@ def decode_bench(model_name="opt-1.3b", *, batch_size=16, prompt=256,
 
     # two run lengths isolate the pure-decode rate from the shared prefill
     dt_full, dt_half = timed(gen), timed(gen // 2)
-    decode_rate = batch_size * (gen - gen // 2) / max(dt_full - dt_half, 1e-9)
+    if dt_full > dt_half:
+        decode_rate = round(batch_size * (gen - gen // 2)
+                            / (dt_full - dt_half) / jax.device_count(), 1)
+    else:
+        decode_rate = None      # timing inversion: measurement invalid
     return {
         "model": model_name,
-        "decode_tokens_per_sec_chip": round(decode_rate / jax.device_count(), 1),
+        "decode_tokens_per_sec_chip": decode_rate,
         "e2e_tokens_per_sec_chip": round(batch_size * gen / dt_full
                                          / jax.device_count(), 1),
         "batch_size": batch_size,

@@ -18,10 +18,8 @@ import numpy as np
 
 
 def _sync_scalar(x):
-    import jax
-    import jax.numpy as jnp
-    leaf = jax.tree.leaves(x)[0]
-    return float(jax.device_get(jnp.sum(leaf[..., :1])))
+    from deepspeed_tpu.utils.sync import dependent_sync_scalar
+    return dependent_sync_scalar(x)
 
 
 def _timeit(fn, args, iters):

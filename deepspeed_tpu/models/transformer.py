@@ -456,31 +456,34 @@ class MLP(nn.Module):
         return dense(cfg.hidden_size, name="down_proj")(h)
 
 
+def _is_moe_layer(cfg, layer_idx):
+    return (cfg.moe_num_experts > 0 and layer_idx is not None
+            and (layer_idx + 1) % cfg.moe_every == 0)
+
+
+def _block_mlp(cfg, layer_idx, h, train=True):
+    """Dense MLP or MoE for one block; returns (out, aux_loss).  A plain
+    function (submodules attach to the calling compact method) so flax's
+    module summary never re-invokes it as a standalone module method.
+    ``train`` selects the gate's capacity/noise regime (reference
+    ``TopKGate`` train vs eval capacity)."""
+    if not _is_moe_layer(cfg, layer_idx):
+        return MLP(cfg, name="mlp")(h), 0.0
+    from deepspeed_tpu.moe.layer import MoE
+    out, aux, _ = MoE(hidden_size=cfg.hidden_size,
+                      num_experts=cfg.moe_num_experts,
+                      ep_size=cfg.moe_ep_size, k=cfg.moe_top_k,
+                      capacity_factor=cfg.moe_capacity_factor,
+                      eval_capacity_factor=cfg.moe_eval_capacity_factor,
+                      ffn_hidden_size=cfg.ffn_size,
+                      dtype=cfg.jnp_dtype, name="moe_mlp")(h, train=train)
+    return out.astype(cfg.jnp_dtype), aux
+
+
 class Block(nn.Module):
     config: TransformerConfig
     layer_idx: Optional[int] = None
 
-    def _is_moe_layer(self):
-        cfg = self.config
-        return (cfg.moe_num_experts > 0 and self.layer_idx is not None
-                and (self.layer_idx + 1) % cfg.moe_every == 0)
-
-    def _mlp(self, h, train=True):
-        """Dense MLP or MoE for this block; returns (out, aux_loss).
-        ``train`` selects the gate's capacity/noise regime (reference
-        ``TopKGate`` train vs eval capacity)."""
-        cfg = self.config
-        if not self._is_moe_layer():
-            return MLP(cfg, name="mlp")(h), 0.0
-        from deepspeed_tpu.moe.layer import MoE
-        out, aux, _ = MoE(hidden_size=cfg.hidden_size,
-                          num_experts=cfg.moe_num_experts,
-                          ep_size=cfg.moe_ep_size, k=cfg.moe_top_k,
-                          capacity_factor=cfg.moe_capacity_factor,
-                          eval_capacity_factor=cfg.moe_eval_capacity_factor,
-                          ffn_hidden_size=cfg.ffn_size,
-                          dtype=cfg.jnp_dtype, name="moe_mlp")(h, train=train)
-        return out.astype(cfg.jnp_dtype), aux
 
     @nn.compact
     def __call__(self, x, positions, mask=None, cache=None, train=True):
@@ -491,7 +494,7 @@ class Block(nn.Module):
                                         name="attn")(x, positions, mask,
                                                      cache)
             x = _norm(cfg, "input_norm")(x + attn).astype(cfg.jnp_dtype)
-            mlp_out, aux = self._mlp(x, train=train)
+            mlp_out, aux = _block_mlp(cfg, self.layer_idx, x, train=train)
             x = _norm(cfg, "post_attn_norm")(x + mlp_out).astype(cfg.jnp_dtype)
             return x, new_cache, aux
         normed = _norm(cfg, "input_norm")(x).astype(cfg.jnp_dtype)
@@ -501,11 +504,13 @@ class Block(nn.Module):
         if cfg.parallel_residual:
             mlp_in = normed if cfg.shared_attn_mlp_norm else \
                 _norm(cfg, "post_attn_norm")(x).astype(cfg.jnp_dtype)
-            mlp_out, aux = self._mlp(mlp_in, train=train)
+            mlp_out, aux = _block_mlp(cfg, self.layer_idx, mlp_in,
+                                      train=train)
             x = x + attn + mlp_out
         else:
             x = x + attn
-            mlp_out, aux = self._mlp(
+            mlp_out, aux = _block_mlp(
+                cfg, self.layer_idx,
                 _norm(cfg, "post_attn_norm")(x).astype(cfg.jnp_dtype),
                 train=train)
             x = x + mlp_out

@@ -528,8 +528,34 @@ class DeepSpeedEngine:
 
         return jax.tree.map(slc, batch)
 
+    def _maybe_start_profiler(self, batch):
+        """Start the flops profiler at the configured step (reference
+        ``engine.py:1692``); training steps only."""
+        if self.flops_profiler is not None \
+                and not self.flops_profiler.started and self.training \
+                and self.global_steps + 1 == \
+                self._config.flops_profiler.profile_step:
+            self.flops_profiler.start_profile()
+            self._profile_batch = batch
+
+    def _maybe_finish_profiler(self):
+        """Stop + print when the profiled step completes (reference: the
+        profile step's report prints at the end of its step)."""
+        if self.flops_profiler is not None and self.flops_profiler.started:
+            pcfg = self._config.flops_profiler
+            self.flops_profiler.stop_profile()
+            self.flops_profiler.print_model_profile(
+                profile_step=pcfg.profile_step,
+                module_depth=pcfg.module_depth,
+                top_modules=pcfg.top_modules,
+                detailed=pcfg.detailed,
+                output_file=pcfg.output_file,
+                batch=getattr(self, "_profile_batch", None))
+
     def forward(self, *args, **kwargs):
         self._lazy_init(args, kwargs)
+        self._maybe_start_profiler(
+            next((a for a in args if _is_batch_like(a)), None))
         args = tuple(self._curriculum_slice(a, 1) if _is_batch_like(a) else a
                      for a in args)
         kwargs = {k: self._curriculum_slice(v, 1) if _is_batch_like(v) else v
@@ -645,6 +671,7 @@ class DeepSpeedEngine:
                 log_dist(f"overflow: skipping step, new loss scale "
                          f"{float(jax.device_get(self._scaler_state.scale))}", ranks=[0])
         self.tput_timer.stop(global_step=True)
+        self._maybe_finish_profiler()
         if self.monitor.enabled and self.global_steps % self.steps_per_print() == 0:
             events = [("Train/Samples/lr", self.get_lr()[0], self.global_samples)]
             if self._last_loss is not None:
@@ -801,6 +828,7 @@ class DeepSpeedEngine:
             self.step()
             return self._last_loss
         self._lazy_init((jax.tree.map(lambda x: x[0], batch),), {})
+        self._maybe_start_profiler(jax.tree.map(lambda x: x[0], batch))
         batch = self._curriculum_slice(batch, 2)
         batch = jax.tree.map(
             lambda x: jax.device_put(
@@ -821,6 +849,7 @@ class DeepSpeedEngine:
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self.tput_timer.stop(global_step=True)
+        self._maybe_finish_profiler()
         if self.monitor.enabled and self.global_steps % self.steps_per_print() == 0:
             # same Train/Samples series the 3-call path emits — fetching the
             # loss here syncs, but only every steps_per_print steps

@@ -1,0 +1,18 @@
+"""Device-sync helpers.
+
+Through the axon tunnel ``jax.block_until_ready`` can return before the
+device work is actually done; the reliable fence is a DEPENDENT transfer —
+fetching a scalar derived from the output forces completion.  Every timing
+path (bench.py, op_bench, flops profiler) must use this one helper.
+"""
+
+import numpy as np
+
+import jax
+
+
+def dependent_sync_scalar(x):
+    """Block until ``x`` (array or pytree) is computed by fetching one
+    scalar derived from it; returns that scalar as a float."""
+    leaf = jax.tree.leaves(x)[0]
+    return float(np.asarray(jax.device_get(leaf)).reshape(-1)[0])

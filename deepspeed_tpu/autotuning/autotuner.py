@@ -65,7 +65,9 @@ class Autotuner:
         self.zero_stages = zero_stages
         self.results_dir = self.at_cfg.results_dir
         self.exps_dir = self.at_cfg.exps_dir
-        self.rm = ResourceManager(self._run_experiment, exps_dir=self.exps_dir)
+        self.rm = ResourceManager(self._run_experiment, exps_dir=self.exps_dir,
+                                  num_workers=self.at_cfg.num_workers,
+                                  exp_timeout=self.at_cfg.exp_timeout)
         self.best_exp = None
         self.best_metric_val = None
         self._model_info = None

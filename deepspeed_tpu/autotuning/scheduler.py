@@ -2,16 +2,34 @@
 ``ResourceManager``).
 
 The reference fans experiments out over multi-node GPU slots via the
-launcher.  On TPU an experiment is a fresh jitted program on the same
-mesh, so the manager runs candidates sequentially in-process — each run
-re-jits with the candidate's config, which is exactly the isolation the
-reference gets from separate processes (XLA programs share nothing but the
-device).
+launcher, polls for completion, and reaps stragglers.  The TPU analog keeps
+the same scheduling machinery — a pool of named resource slots, parallel
+dispatch, per-experiment status/timing files, timeouts, and an early-stop
+hook that cancels still-pending experiments — with one substitution: an
+"experiment" is a callable (typically a fresh jitted program) instead of a
+launcher subprocess.
+
+Concurrency note: experiments that EXECUTE on the chip should use one slot
+(``num_workers=1``, the default) — concurrent device programs would contend
+for HBM and corrupt each other's timings.  Compile-only prechecks, cost-model
+evaluations, and simulated/multi-host ``run_fn``s parallelize safely across
+slots.
 """
 
 import json
 import os
+import threading
+import time
 import traceback
+from concurrent.futures import ThreadPoolExecutor, wait, FIRST_COMPLETED
+
+# Experiment lifecycle (reference scheduler's job states).
+PENDING = "pending"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+TIMEOUT = "timeout"
+SKIPPED = "skipped"          # cancelled by early stop before it ran
 
 
 class Experiment:
@@ -26,35 +44,136 @@ class Experiment:
         self.config = config
         self.results = {}
         self.error = None
+        self.status = PENDING
+        self.slot = None
+        self.start_time = None
+        self.end_time = None
 
     def to_dict(self):
         return {"exp_id": self.exp_id, "name": self.name, "config": self.config,
-                "results": self.results, "error": self.error}
+                "results": self.results, "error": self.error,
+                "status": self.status, "slot": self.slot,
+                "duration_s": (round(self.end_time - self.start_time, 3)
+                               if self.start_time and self.end_time else None)}
 
 
 class ResourceManager:
-    """Runs experiments through a caller-supplied ``run_fn(exp) -> dict`` and
-    persists each result under ``exps_dir`` (reference ResourceManager
-    ``schedule_experiments``/``run_job``)."""
+    """Runs experiments through a caller-supplied ``run_fn(exp) -> dict``
+    across a pool of resource slots, persisting each result under
+    ``exps_dir`` (reference ResourceManager ``schedule_experiments`` /
+    ``run_job`` / ``parse_results``).
 
-    def __init__(self, run_fn, exps_dir=None):
+    ``resources``: slot names (reference: ``hostname:slot`` pairs); default
+    ``num_workers`` local slots.  ``exp_timeout``: seconds after which a
+    finished experiment is recorded as TIMEOUT (a thread cannot be killed —
+    matching the reference, which reaps the subprocess but still waits for
+    the ssh session — so the wall-clock loss is bounded by the slowest
+    straggler).
+    """
+
+    def __init__(self, run_fn, exps_dir=None, resources=None, num_workers=1,
+                 exp_timeout=None):
         self.run_fn = run_fn
         self.exps_dir = exps_dir
+        if resources is None:
+            resources = [f"localhost:{i}" for i in range(max(1, num_workers))]
+        self.resources = list(resources)
+        self.exp_timeout = exp_timeout
         self.finished_experiments = []
+        self._free = list(self.resources)
+        self._lock = threading.Lock()
         if exps_dir:
             os.makedirs(exps_dir, exist_ok=True)
 
-    def schedule_experiments(self, exps):
-        for exp in exps:
-            try:
-                exp.results = self.run_fn(exp) or {}
-            except Exception as e:  # an OOM/compile failure is a data point
-                exp.error = f"{type(e).__name__}: {e}"
+    # ------------------------------------------------------------------ #
+    def _acquire_slot(self):
+        with self._lock:
+            return self._free.pop(0) if self._free else None
+
+    def _release_slot(self, slot):
+        with self._lock:
+            self._free.append(slot)
+
+    def _run_one(self, exp):
+        slot = self._acquire_slot()
+        exp.slot = slot
+        exp.status = RUNNING
+        exp.start_time = time.time()
+        try:
+            exp.results = self.run_fn(exp) or {}
+            exp.status = DONE
+        except Exception as e:  # an OOM/compile failure is a data point
+            exp.error = f"{type(e).__name__}: {e}"
+            exp.results = {}
+            exp.status = FAILED
+            traceback.print_exc()
+        finally:
+            exp.end_time = time.time()
+            if self.exp_timeout and exp.status == DONE and \
+                    exp.end_time - exp.start_time > self.exp_timeout:
+                # a straggler's measurement is suspect: drop its results so
+                # the tuner can never select it (the reference reaps timed-
+                # out jobs, which contribute no results either)
+                exp.status = TIMEOUT
                 exp.results = {}
-                traceback.print_exc()
-            self.finished_experiments.append(exp)
-            if self.exps_dir:
-                path = os.path.join(self.exps_dir, f"exp_{exp.exp_id}_{exp.name}.json")
-                with open(path, "w") as f:
-                    json.dump(exp.to_dict(), f, indent=2, default=str)
+                exp.error = f"exceeded exp_timeout={self.exp_timeout}s"
+            if slot is not None:
+                self._release_slot(slot)
+        return exp
+
+    def _persist(self, exp):
+        if self.exps_dir:
+            path = os.path.join(self.exps_dir,
+                                f"exp_{exp.exp_id}_{exp.name}.json")
+            with open(path, "w") as f:
+                json.dump(exp.to_dict(), f, indent=2, default=str)
+
+    # ------------------------------------------------------------------ #
+    def schedule_experiments(self, exps, early_stop_fn=None):
+        """Dispatch ``exps`` over the slot pool; returns them with results.
+
+        ``early_stop_fn(finished_experiments) -> bool``: consulted after
+        every completion; once true, experiments not yet started are marked
+        SKIPPED (the reference's cross-node early stop — pending jobs are
+        never launched; running ones drain)."""
+        exps = list(exps)
+        if len(self.resources) == 1:
+            # sequential fast path: no thread overhead, same semantics
+            for i, exp in enumerate(exps):
+                self._run_one(exp)
+                self.finished_experiments.append(exp)
+                self._persist(exp)
+                if early_stop_fn and early_stop_fn(self.finished_experiments):
+                    for rest in exps[i + 1:]:
+                        rest.status = SKIPPED
+                        self.finished_experiments.append(rest)
+                        self._persist(rest)
+                    break
+            return exps
+
+        stop = threading.Event()
+        with ThreadPoolExecutor(max_workers=len(self.resources)) as pool:
+            pending = list(exps)
+            futures = {}
+            while pending or futures:
+                while pending and len(futures) < len(self.resources) \
+                        and not stop.is_set():
+                    exp = pending.pop(0)
+                    futures[pool.submit(self._run_one, exp)] = exp
+                if stop.is_set() and pending:
+                    for exp in pending:
+                        exp.status = SKIPPED
+                        self.finished_experiments.append(exp)
+                        self._persist(exp)
+                    pending = []
+                if not futures:
+                    break
+                done, _ = wait(list(futures), return_when=FIRST_COMPLETED)
+                for fut in done:
+                    exp = futures.pop(fut)
+                    self.finished_experiments.append(exp)
+                    self._persist(exp)
+                    if early_stop_fn and \
+                            early_stop_fn(self.finished_experiments):
+                        stop.set()
         return exps

@@ -315,14 +315,15 @@ def model_profile_tree(module, rngs, *args, measure_latency=True,
             # may already be near HBM capacity
             variables = module.init(rngs, *args, **kwargs)
         fn = jax.jit(lambda v, *a: module.apply(v, *a, **kwargs))
-        out = fn(variables, *args)
-        jax.block_until_ready(out)
+        # one compile serves warmup, the profiled run, AND the HLO text
+        # (jit dispatch would compile a second executable)
         compiled = fn.lower(variables, *args).compile()
         scopes = _hlo_op_scopes(compiled.as_text())
         from deepspeed_tpu.utils.sync import dependent_sync_scalar
+        dependent_sync_scalar(compiled(variables, *args))   # warmup
 
         def run():
-            dependent_sync_scalar(fn(variables, *args))
+            dependent_sync_scalar(compiled(variables, *args))
 
         stats = _trace_op_stats(run)
         for op, (ps, flops) in stats.items():

@@ -274,6 +274,11 @@ class AutotuningConfig(DeepSpeedConfigModel):
     tuner_num_trials: int = 50
     max_train_batch_size: Optional[int] = None
     min_train_batch_size: int = 1
+    # ResourceManager slots (reference scheduler.py:33): >1 parallelizes
+    # experiment dispatch — safe for compile-precheck / simulated / multi-
+    # host run_fns; keep 1 for on-chip measurement runs (HBM contention)
+    num_workers: int = 1
+    exp_timeout: Optional[float] = None
 
 
 # --------------------------------------------------------------------- #

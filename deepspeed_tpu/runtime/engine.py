@@ -554,10 +554,12 @@ class DeepSpeedEngine:
 
     def forward(self, *args, **kwargs):
         self._lazy_init(args, kwargs)
-        self._maybe_start_profiler(
-            next((a for a in args if _is_batch_like(a)), None))
         args = tuple(self._curriculum_slice(a, 1) if _is_batch_like(a) else a
                      for a in args)
+        # capture the batch AFTER curriculum slicing so the profiled program
+        # has the shapes the step actually runs
+        self._maybe_start_profiler(
+            next((a for a in args if _is_batch_like(a)), None))
         kwargs = {k: self._curriculum_slice(v, 1) if _is_batch_like(v) else v
                   for k, v in kwargs.items()}
         args = tuple(self.put_batch(a) if _is_batch_like(a) else a for a in args)
@@ -828,8 +830,8 @@ class DeepSpeedEngine:
             self.step()
             return self._last_loss
         self._lazy_init((jax.tree.map(lambda x: x[0], batch),), {})
-        self._maybe_start_profiler(jax.tree.map(lambda x: x[0], batch))
         batch = self._curriculum_slice(batch, 2)
+        self._maybe_start_profiler(jax.tree.map(lambda x: x[0], batch))
         batch = jax.tree.map(
             lambda x: jax.device_put(
                 jnp.asarray(x),

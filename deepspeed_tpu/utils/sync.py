@@ -6,13 +6,18 @@ fetching a scalar derived from the output forces completion.  Every timing
 path (bench.py, op_bench, flops profiler) must use this one helper.
 """
 
-import numpy as np
-
 import jax
+import jax.numpy as jnp
 
 
 def dependent_sync_scalar(x):
     """Block until ``x`` (array or pytree) is computed by fetching one
-    scalar derived from it; returns that scalar as a float."""
+    scalar derived from it; returns that scalar as a float.
+
+    The derivation happens ON DEVICE (a reduce over a unit slice), so the
+    transfer is ~8 bytes regardless of the output size — never a full-leaf
+    device-to-host copy inside a timed region."""
     leaf = jax.tree.leaves(x)[0]
-    return float(np.asarray(jax.device_get(leaf)).reshape(-1)[0])
+    if getattr(leaf, "ndim", 0):
+        leaf = jnp.sum(leaf[..., :1])
+    return float(jax.device_get(leaf))

@@ -251,7 +251,9 @@ class Autotuner:
                     + ", ".join(e.name for e in exps))
         tuner = self._build_tuner(exps)
         self.best_exp, self.best_metric_val = tuner.tune(
-            sample_size=1,
+            # batch per round = slot count, so num_workers>1 actually
+            # overlaps experiments inside schedule_experiments
+            sample_size=len(self.rm.resources),
             n_trials=self.at_cfg.tuner_num_trials,
             early_stopping=self.at_cfg.tuner_early_stopping)
         self._write_results()

@@ -129,8 +129,25 @@ class InferenceEngine:
         self.set_params(params)
 
     def load_checkpoint(self, path, tag=None):
+        """Directory → engine (Orbax) checkpoint; single file → a
+        ``save_16bit_model`` export (safetensors / torch state dict with
+        flax-named keys; legacy pickled pytrees still load).  HF-named
+        exports (``hf_policy=...``) go through ``module_inject`` instead."""
         import os, pickle
         if os.path.isfile(path):
+            if path.endswith(".safetensors"):
+                from safetensors.numpy import load_file
+                self.set_params(_unflatten_flax_paths(load_file(path)))
+                return
+            try:
+                import torch
+                sd = torch.load(path, map_location="cpu")
+                self.set_params(_unflatten_flax_paths(
+                    {k: (v.float().numpy() if hasattr(v, "numpy") else v)
+                     for k, v in sd.items()}))
+                return
+            except (pickle.UnpicklingError, RuntimeError, ImportError):
+                pass
             with open(path, "rb") as f:
                 self.set_params(pickle.load(f))
             return
@@ -218,6 +235,26 @@ class InferenceEngine:
                                 bool(do_sample), float(temperature), int(top_k),
                                 float(top_p))
         return fn(self._params, input_ids, rng, jnp.asarray(eos_token_id))
+
+
+def _unflatten_flax_paths(flat):
+    """{'a/b/c': array} → nested variables dict, re-rooted under 'params'
+    when the export stripped that collection prefix (save_16bit_model
+    does).  HF-named keys (dots, no flax structure) raise with guidance."""
+    if any("." in k and "/" not in k for k in flat):
+        raise ValueError(
+            "this file carries HF-named keys (hf_policy export); load it "
+            "through module_inject's policy convert + _materialize instead")
+    nested = {}
+    for key, val in flat.items():
+        parts = key.split("/")
+        if parts[0] != "params":
+            parts = ["params"] + parts
+        node = nested
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return nested
 
 
 def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,

@@ -69,6 +69,65 @@ class OPTPolicy(HFPolicy):
         out["mlp/down_proj/bias"] = _np(sd[f"{p}.fc2.bias"])
         return out
 
+    def export_convert(self, flat, cfg):
+        """Inverse of convert: flax flat params → HF OPT state dict (the
+        key table ``layer_params``/``top_params`` read from, inverted —
+        reference ``engine.py:3297`` save_16bit_model emits HF-loadable
+        names)."""
+        from deepspeed_tpu.module_inject.policy import (
+            inv_linear_kernel, inv_o_kernel, inv_qkv_bias, inv_qkv_kernel)
+        sd = {"model.decoder.embed_tokens.weight":
+              np.asarray(flat["embed_tokens/embedding"])}
+        pos = np.asarray(flat["embed_positions/embedding"])
+        # restore OPTLearnedPositionalEmbedding's +2 offset rows (HF indexes
+        # past them via the offset; their values are never read)
+        sd["model.decoder.embed_positions.weight"] = np.concatenate(
+            [np.zeros((2, pos.shape[1]), pos.dtype), pos])
+        if cfg.pre_layer_norm:
+            sd["model.decoder.final_layer_norm.weight"] = \
+                np.asarray(flat["final_norm/scale"])
+            sd["model.decoder.final_layer_norm.bias"] = \
+                np.asarray(flat["final_norm/bias"])
+        if cfg.embed_proj_dim is not None:
+            sd["model.decoder.project_in.weight"] = \
+                inv_linear_kernel(flat["project_in/kernel"])
+            sd["model.decoder.project_out.weight"] = \
+                inv_linear_kernel(flat["project_out/kernel"])
+        if not cfg.tie_word_embeddings and "lm_head/kernel" in flat:
+            sd["lm_head.weight"] = inv_linear_kernel(flat["lm_head/kernel"])
+
+        def src(i, key):
+            if getattr(cfg, "scan_layers", True):
+                return np.asarray(flat[f"layers/{key}"])[i]
+            return np.asarray(flat[f"layers_{i}/{key}"])
+
+        def has(i, key):
+            return (f"layers/{key}" in flat) if getattr(cfg, "scan_layers",
+                                                        True) \
+                else (f"layers_{i}/{key}" in flat)
+
+        for i in range(cfg.num_layers):
+            p = f"model.decoder.layers.{i}"
+            for std in ("q_proj", "k_proj", "v_proj"):
+                sd[f"{p}.self_attn.{std}.weight"] = \
+                    inv_qkv_kernel(src(i, f"attn/{std}/kernel"))
+                if has(i, f"attn/{std}/bias"):
+                    sd[f"{p}.self_attn.{std}.bias"] = \
+                        inv_qkv_bias(src(i, f"attn/{std}/bias"))
+            sd[f"{p}.self_attn.out_proj.weight"] = \
+                inv_o_kernel(src(i, "attn/o_proj/kernel"))
+            if has(i, "attn/o_proj/bias"):
+                sd[f"{p}.self_attn.out_proj.bias"] = src(i, "attn/o_proj/bias")
+            sd[f"{p}.self_attn_layer_norm.weight"] = src(i, "input_norm/scale")
+            sd[f"{p}.self_attn_layer_norm.bias"] = src(i, "input_norm/bias")
+            sd[f"{p}.final_layer_norm.weight"] = src(i, "post_attn_norm/scale")
+            sd[f"{p}.final_layer_norm.bias"] = src(i, "post_attn_norm/bias")
+            sd[f"{p}.fc1.weight"] = inv_linear_kernel(src(i, "mlp/up_proj/kernel"))
+            sd[f"{p}.fc1.bias"] = src(i, "mlp/up_proj/bias")
+            sd[f"{p}.fc2.weight"] = inv_linear_kernel(src(i, "mlp/down_proj/kernel"))
+            sd[f"{p}.fc2.bias"] = src(i, "mlp/down_proj/bias")
+        return sd
+
 
 class GPT2Policy(HFPolicy):
     """gpt2* (reference ``containers/gpt2.py`` / megatron containers).

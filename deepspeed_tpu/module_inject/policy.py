@@ -61,6 +61,29 @@ def o_kernel(w, heads, head_dim):
     return np.ascontiguousarray(_np(w).T.reshape(heads, head_dim, -1))
 
 
+# -- inverse transforms (export: flax params → HF state dict) ----------- #
+def inv_linear_kernel(k):
+    """flax kernel [in, out] → torch Linear weight [out, in]."""
+    return np.ascontiguousarray(np.asarray(k).T)
+
+
+def inv_qkv_kernel(k):
+    """flax DenseGeneral kernel [in, H, D] → torch [H*D, in]."""
+    a = np.asarray(k)
+    return np.ascontiguousarray(a.reshape(a.shape[0], -1).T)
+
+
+def inv_qkv_bias(b):
+    """flax bias [H, D] → torch [H*D]."""
+    return np.ascontiguousarray(np.asarray(b).reshape(-1))
+
+
+def inv_o_kernel(k):
+    """flax DenseGeneral kernel [H, D, hidden] → torch [hidden, H*D]."""
+    a = np.asarray(k)
+    return np.ascontiguousarray(a.reshape(-1, a.shape[-1]).T)
+
+
 def split_fused_qkv_headwise(w, heads, head_dim, bias=None):
     """Split a head-interleaved fused QKV (neox/bloom layout: output rows
     arranged [H, 3, D]) into per-projection flax kernels.
@@ -122,6 +145,15 @@ class HFPolicy:
     def top_params(self, sd, cfg) -> dict:
         """{path: array} for embeddings / final norm / lm head."""
         raise NotImplementedError
+
+    def export_convert(self, flat, cfg) -> dict:
+        """Inverse of :meth:`convert`: flat flax params {path: array} →
+        HF-named state dict {hf_key: np.ndarray} (reference
+        ``save_16bit_model``'s output is consumable by HF loaders).
+        Policies implement this per family."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement export_convert; "
+            "save_16bit_model falls back to flax-named keys")
 
     def convert(self, sd, cfg):
         """Full flat param dict {path: np.ndarray}: scanned layers stack on a

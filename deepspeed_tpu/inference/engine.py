@@ -139,15 +139,17 @@ class InferenceEngine:
                 from safetensors.numpy import load_file
                 self.set_params(_unflatten_flax_paths(load_file(path)))
                 return
+            sd = None
             try:
                 import torch
                 sd = torch.load(path, map_location="cpu")
+            except (pickle.UnpicklingError, RuntimeError, ImportError):
+                pass                     # not a torch file → legacy pickle
+            if sd is not None:
                 self.set_params(_unflatten_flax_paths(
                     {k: (v.float().numpy() if hasattr(v, "numpy") else v)
                      for k, v in sd.items()}))
                 return
-            except (pickle.UnpicklingError, RuntimeError, ImportError):
-                pass
             with open(path, "rb") as f:
                 self.set_params(pickle.load(f))
             return
@@ -245,16 +247,10 @@ def _unflatten_flax_paths(flat):
         raise ValueError(
             "this file carries HF-named keys (hf_policy export); load it "
             "through module_inject's policy convert + _materialize instead")
-    nested = {}
-    for key, val in flat.items():
-        parts = key.split("/")
-        if parts[0] != "params":
-            parts = ["params"] + parts
-        node = nested
-        for p in parts[:-1]:
-            node = node.setdefault(p, {})
-        node[parts[-1]] = val
-    return nested
+    from deepspeed_tpu.compression.helper import unflatten_params
+    return unflatten_params(
+        {(k if k.startswith("params/") else f"params/{k}"): v
+         for k, v in flat.items()})
 
 
 def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,

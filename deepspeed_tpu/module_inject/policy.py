@@ -152,8 +152,8 @@ class HFPolicy:
         ``save_16bit_model``'s output is consumable by HF loaders).
         Policies implement this per family."""
         raise NotImplementedError(
-            f"{type(self).__name__} does not implement export_convert; "
-            "save_16bit_model falls back to flax-named keys")
+            f"{type(self).__name__} has no HF export mapping yet; call "
+            "save_16bit_model without hf_policy for flax-named keys")
 
     def convert(self, sd, cfg):
         """Full flat param dict {path: np.ndarray}: scanned layers stack on a

@@ -1,0 +1,127 @@
+"""Model-tier convergence sanity checks — the analog of reference
+``tests/model/Megatron_GPT2/run_sanity_check.py`` (+ BingBertSquad): train a
+REAL (small) GPT through the full production stack to an absolute loss
+threshold with a fixed seed, prove determinism, and prove checkpoint-resume
+preserves the trajectory.
+
+Unlike the unit tier (a few steps, "loss decreased"), this tier demands
+actual convergence on a learnable language task and runs the composition a
+user would: 4-layer GPT2-style trunk, fused engine step, ZeRO sharding on
+the 8-device CPU mesh, bf16 + TP variants.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import Transformer, TransformerConfig
+
+VOCAB = 96
+SEQ = 64
+SEED = 1234
+
+
+def gpt_cfg(**over):
+    """4-layer GPT2-style decoder (gelu MLP, learned positions, pre-LN)."""
+    base = dict(vocab_size=VOCAB, hidden_size=128, num_layers=4, num_heads=4,
+                max_seq_len=SEQ, activation="gelu",
+                position_embedding="learned", dtype="float32",
+                use_flash_attention=False, remat=False, scan_layers=True)
+    base.update(over)
+    return TransformerConfig(**base)
+
+
+def lm_batch(rng, bs=8):
+    """Learnable synthetic language: each row is a random 8-token phrase
+    repeated — an induction task a 4-layer GPT must drive far below the
+    uniform baseline ln(96) ~ 4.56."""
+    phrase = rng.integers(2, VOCAB, (bs, 8)).astype(np.int32)
+    ids = np.tile(phrase, (1, SEQ // 8))
+    return {"input_ids": ids}
+
+
+def make_engine(config_over=None, cfg_over=None, seed=SEED):
+    config = {
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+        "zero_optimization": {"stage": 3},
+        "gradient_clipping": 1.0,
+        "seed": seed,
+    }
+    config.update(config_over or {})
+    engine, *_ = deepspeed_tpu.initialize(
+        model=Transformer(gpt_cfg(**(cfg_over or {}))), config=config)
+    return engine
+
+
+def run(engine, steps, rng):
+    losses = []
+    for _ in range(steps):
+        loss = engine(lm_batch(rng))
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    return losses
+
+
+def test_gpt4l_converges_to_threshold():
+    """Fixed seed, absolute target: the induction task must reach loss
+    < 1.0 (uniform baseline ~4.56, init ~ln V) within 200 steps."""
+    engine = make_engine()
+    losses = run(engine, 200, np.random.default_rng(SEED))
+    assert losses[0] > 3.0, f"suspicious init loss {losses[0]}"
+    assert min(losses[-10:]) < 1.0, \
+        f"no convergence: first={losses[0]:.3f} last10={losses[-10:]}"
+
+
+def test_convergence_is_deterministic():
+    """Two fresh runs with the same seed produce the SAME trajectory —
+    the jit-determinism guarantee standing in for the reference's
+    race-detection tier (SURVEY §5)."""
+    a = run(make_engine(), 30, np.random.default_rng(SEED))
+    from deepspeed_tpu.parallel.topology import reset_topology
+    reset_topology()
+    b = run(make_engine(), 30, np.random.default_rng(SEED))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+
+def test_resume_preserves_trajectory(tmp_path):
+    """Checkpoint mid-training, resume in a FRESH engine: the resumed
+    trajectory matches an uninterrupted run step-for-step (same data
+    stream, same fold_in(step) rng), and training converges."""
+    from deepspeed_tpu.parallel.topology import reset_topology
+
+    data = np.random.default_rng(SEED)
+    ref_engine = make_engine()
+    ref = run(ref_engine, 80, data)
+
+    reset_topology()
+    data = np.random.default_rng(SEED)
+    e1 = make_engine()
+    run(e1, 40, data)
+    e1.save_checkpoint(str(tmp_path))
+
+    reset_topology()
+    e2 = make_engine()
+    e2.load_checkpoint(str(tmp_path))
+    assert e2.global_steps == 40
+    resumed = run(e2, 40, data)
+    np.testing.assert_allclose(resumed, ref[40:], rtol=1e-4, atol=1e-5)
+    assert min(resumed[-10:]) < 1.5
+
+
+@pytest.mark.parametrize("variant", ["bf16_zero1", "tp2_zero3"])
+def test_convergence_across_parallel_variants(variant):
+    """The same task converges under the bf16 and TP compositions."""
+    if variant == "bf16_zero1":
+        engine = make_engine({"bf16": {"enabled": True},
+                              "zero_optimization": {"stage": 1}})
+        threshold = 1.3          # bf16 rounding slows the tail slightly
+    else:
+        engine = make_engine({"tensor_parallel": {"tp_size": 2}})
+        threshold = 1.0
+    losses = run(engine, 200, np.random.default_rng(SEED))
+    assert min(losses[-10:]) < threshold, \
+        f"{variant}: last10={losses[-10:]}"

@@ -326,17 +326,20 @@ def _attention(q, k, v, config, mask=None, bias=None, window=None):
 
 
 def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
-                     window=None):
+                     window=None, layer=None):
     """Decode attention against a KV cache.
 
-    q: [B, S, H, D]; caches: [B, KVH, S_max, D] (head-major); q_positions:
-    [B, S] absolute positions.  KV entries at positions > q_pos are masked —
-    this covers both causality and the unwritten cache tail.  TPU-native
-    analog of the reference ``softmax_context`` KV-cache op
+    q: [B, S, H, D]; caches: [B, KVH, S_max, D] (head-major) — or, with
+    ``layer`` given, the FULL layer-stacked [L, B, KVH, S_max, D] cache
+    (the Pallas kernel indexes the layer itself; no per-layer slice is
+    materialized).  q_positions: [B, S] absolute positions.  KV entries at
+    positions > q_pos are masked — this covers both causality and the
+    unwritten cache tail.  TPU-native analog of the reference
+    ``softmax_context`` KV-cache op
     (``csrc/transformer/inference/csrc/pt_binding.cpp``).
     """
     B, S, H, D = q.shape
-    KVH, S_max = k_cache.shape[1], k_cache.shape[2]
+    KVH, S_max = k_cache.shape[-3], k_cache.shape[-2]
     if S == 1 and bias is None and window is None:
         # single-token decode: the Pallas online-softmax kernel streams the
         # cache blockwise instead of materializing [B,H,1,S_max] fp32 logits
@@ -347,7 +350,13 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
         if pallas_supported():
             lengths = (q_positions[:, 0] + 1).astype(jnp.int32)
             return decode_attention(q[:, 0], k_cache, v_cache,
-                                    lengths)[:, None]
+                                    lengths, layer=layer)[:, None]
+    if layer is not None:
+        # dense fallback needs the layer slice after all
+        k_cache = jax.lax.dynamic_index_in_dim(k_cache, layer, 0,
+                                               keepdims=False)
+        v_cache = jax.lax.dynamic_index_in_dim(v_cache, layer, 0,
+                                               keepdims=False)
     if KVH != H:
         rep = H // KVH
         k_cache = jnp.repeat(k_cache, rep, axis=1)
@@ -398,7 +407,7 @@ class Attention(nn.Module):
             # flash/decode kernels need no changes
             q = q * jnp.asarray(cfg.attention_softmax_scale * np.sqrt(D),
                                 q.dtype)
-        bias = alibi_bias(H, cache["k"].shape[2] if cache is not None
+        bias = alibi_bias(H, cache["k"].shape[-2] if cache is not None
                           else x.shape[1]) \
             if cfg.position_embedding == "alibi" else None
         if cache is not None:
@@ -415,15 +424,35 @@ class Attention(nn.Module):
             # decode kernel blocks the seq dim with NO relayout of the
             # full cache — only the new S_step tokens transpose)
             start = positions[0, 0]
-            k_cache = jax.lax.dynamic_update_slice(
-                cache["k"], k.transpose(0, 2, 1, 3).astype(cache["k"].dtype),
-                (0, 0, start, 0))
-            v_cache = jax.lax.dynamic_update_slice(
-                cache["v"], v.transpose(0, 2, 1, 3).astype(cache["v"].dtype),
-                (0, 0, start, 0))
-            out = cached_attention(q, k_cache, v_cache, positions, bias=bias,
-                                   window=window)
-            new_cache = {"k": k_cache, "v": v_cache}
+            k_new = k.transpose(0, 2, 1, 3)
+            v_new = v.transpose(0, 2, 1, 3)
+            if "layer" in cache:
+                # stacked-carry decode: the FULL [L, B, KVH, S_max, D]
+                # cache rides the layer-scan carry and only this step's
+                # tokens are written — never a full-cache rewrite per
+                # token (the nn.scan ys path re-materialized ~the whole
+                # cache every decode step).  The Pallas decode kernel
+                # indexes the layer itself, so no slice materializes.
+                li = cache["layer"]
+                k_full = jax.lax.dynamic_update_slice(
+                    cache["k"], k_new[None].astype(cache["k"].dtype),
+                    (li, 0, 0, start, 0))
+                v_full = jax.lax.dynamic_update_slice(
+                    cache["v"], v_new[None].astype(cache["v"].dtype),
+                    (li, 0, 0, start, 0))
+                out = cached_attention(q, k_full, v_full, positions,
+                                       bias=bias, window=window, layer=li)
+                new_cache = {"k": k_full, "v": v_full, "layer": li}
+            else:
+                k_cache = jax.lax.dynamic_update_slice(
+                    cache["k"], k_new.astype(cache["k"].dtype),
+                    (0, 0, start, 0))
+                v_cache = jax.lax.dynamic_update_slice(
+                    cache["v"], v_new.astype(cache["v"].dtype),
+                    (0, 0, start, 0))
+                new_cache = {"k": k_cache, "v": v_cache}
+                out = cached_attention(q, k_cache, v_cache, positions,
+                                       bias=bias, window=window)
         else:
             out = _attention(q, k, v, cfg, mask=mask, bias=bias,
                              window=window)
@@ -518,14 +547,20 @@ class Block(nn.Module):
 
 
 class ScanBlock(Block):
-    """Block with the (carry, output) signature nn.scan requires: the
-    activation is the carry, per-layer KV caches (+ aux losses) are the
-    scanned ys."""
+    """Block with the (carry, output) signature nn.scan requires.  The
+    carry is ``(activation, stacked_cache)``: the FULL ``[L, ...]`` KV
+    cache rides the carry with a per-iteration layer counter, so decode
+    writes ONE token slice per step in place — the previous ys-based
+    design re-materialized the entire cache every decode step (a
+    ~full-HBM-cache write per generated token)."""
 
     @nn.compact
-    def __call__(self, x, positions, mask=None, cache=None):
+    def __call__(self, carry, positions, mask=None):
+        x, cache = carry
         x, new_cache, aux = Block.__call__(self, x, positions, mask, cache)
-        return x, (new_cache, aux)
+        if new_cache is not None:
+            new_cache = dict(new_cache, layer=new_cache["layer"] + 1)
+        return (x, new_cache), aux
 
 
 class Transformer(nn.Module):
@@ -567,7 +602,7 @@ class Transformer(nn.Module):
                 block,
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "dropout": True},
-                in_axes=(nn.broadcast, nn.broadcast, 0),
+                in_axes=(nn.broadcast, nn.broadcast),
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="layers")
@@ -594,19 +629,29 @@ class Transformer(nn.Module):
         if cfg.embedding_norm:
             x = self.embed_norm(x).astype(cfg.jnp_dtype)
         if cfg.scan_layers:
-            x, (new_cache, aux_layers) = self.blocks(x, positions, mask, cache)
+            carry_cache = None if cache is None else \
+                {"k": cache["k"], "v": cache["v"],
+                 "layer": jnp.asarray(0, jnp.int32)}
+            (x, out_cache), aux_layers = self.blocks((x, carry_cache),
+                                                     positions, mask)
             aux = jnp.sum(aux_layers)
+            new_cache = None if cache is None else \
+                {"k": out_cache["k"], "v": out_cache["v"]}
         else:
-            new_layers, aux = [], 0.0
+            aux = 0.0
+            # the full stacked cache threads through the loop; each layer
+            # writes only its token slice (see Attention stacked-carry path)
+            cur = None if cache is None else {"k": cache["k"], "v": cache["v"]}
             for i, blk in enumerate(self.block_list):
-                layer_cache = None if cache is None else \
-                    jax.tree.map(lambda c: c[i], cache)
+                layer_cache = None if cur is None else \
+                    {"k": cur["k"], "v": cur["v"],
+                     "layer": jnp.asarray(i, jnp.int32)}
                 # train positional: static_argnums only covers positionals
                 x, nc, a = blk(x, positions, mask, layer_cache, train)
-                new_layers.append(nc)
+                if cur is not None:
+                    cur = {"k": nc["k"], "v": nc["v"]}
                 aux = aux + a
-            new_cache = None if cache is None else \
-                jax.tree.map(lambda *cs: jnp.stack(cs), *new_layers)
+            new_cache = cur
         h = self.final_norm(x).astype(cfg.jnp_dtype) \
             if cfg.pre_layer_norm else x
         if with_aux:

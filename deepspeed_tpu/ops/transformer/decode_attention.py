@@ -29,8 +29,8 @@ from deepspeed_tpu.ops.transformer.flash_attention import (LSE_LANES, NEG_INF,
 DEFAULT_BLOCK_K_DECODE = 512
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-                   *, scale, block_k, nk):
+def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale, block_k, nk, stacked):
     b = pl.program_id(0)
     ik = pl.program_id(2)
 
@@ -45,8 +45,8 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
     @pl.when(ik * block_k < length)
     def _body():
         q = q_ref[0, 0]                                  # [G, D]
-        k = k_ref[0, 0]                                  # [bk, D]
-        v = v_ref[0, 0]
+        k = k_ref[0, 0, 0] if stacked else k_ref[0, 0]   # [bk, D]
+        v = v_ref[0, 0, 0] if stacked else v_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         pos = ik * block_k + jax.lax.broadcasted_iota(
@@ -73,41 +73,54 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
 
 
 def decode_attention(q, k_cache, v_cache, lengths,
-                     scale=None, block_k=DEFAULT_BLOCK_K_DECODE):
+                     scale=None, block_k=DEFAULT_BLOCK_K_DECODE, layer=None):
     """Single-token decode attention.
 
     q: [B, H, D] (this step's query); caches: [B, KVH, S_max, D]
     (head-major — the model stores them this way so NO cache relayout
-    happens per decode step); lengths: [B] int32 — number of valid cache
-    entries INCLUDING this step's freshly-written position.
-    Returns [B, H, D].
+    happens per decode step), or the FULL layer-stacked
+    [L, B, KVH, S_max, D] cache with ``layer`` a (traced) layer index —
+    the kernel's index maps then DMA only this layer's blocks, so the
+    caller never materializes a per-layer slice of the stacked cache.
+    lengths: [B] int32 — number of valid cache entries INCLUDING this
+    step's freshly-written position.  Returns [B, H, D].
     """
     B, H, D = q.shape
-    KVH, S_max = k_cache.shape[1], k_cache.shape[2]
+    stacked = k_cache.ndim == 5
+    if stacked and layer is None:
+        raise ValueError("stacked [L, ...] caches require layer=")
+    KVH, S_max = k_cache.shape[-3], k_cache.shape[-2]
     G = H // KVH                                         # query heads per kv head
     if scale is None:
         scale = 1.0 / float(np.sqrt(D))
     block_k = min(block_k, S_max)
     nk = pl.cdiv(S_max, block_k)
     qg = q.reshape(B, KVH, G, D)
-    kt = k_cache
-    vt = v_cache
+    layer_arr = jnp.asarray([layer if layer is not None else 0], jnp.int32)
+
+    if stacked:
+        kv_spec = pl.BlockSpec(
+            (1, 1, 1, block_k, D),
+            lambda b, h, ik, lens, li: (li[0], b, h, ik, 0))
+    else:
+        kv_spec = pl.BlockSpec(
+            (1, 1, block_k, D),
+            lambda b, h, ik, lens, li: (b, h, ik, 0))
 
     out = pl.pallas_call(
         functools.partial(_decode_kernel, scale=float(scale),
-                          block_k=block_k, nk=nk),
+                          block_k=block_k, nk=nk, stacked=stacked),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(B, KVH, nk),
             in_specs=[
-                pl.BlockSpec((1, 1, G, D), lambda b, h, ik, lens: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, block_k, D),
-                             lambda b, h, ik, lens: (b, h, ik, 0)),
-                pl.BlockSpec((1, 1, block_k, D),
-                             lambda b, h, ik, lens: (b, h, ik, 0)),
+                pl.BlockSpec((1, 1, G, D),
+                             lambda b, h, ik, lens, li: (b, h, 0, 0)),
+                kv_spec,
+                kv_spec,
             ],
             out_specs=pl.BlockSpec((1, 1, G, D),
-                                   lambda b, h, ik, lens: (b, h, 0, 0)),
+                                   lambda b, h, ik, lens, li: (b, h, 0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((G, LSE_LANES), jnp.float32),
                 pltpu.VMEM((G, LSE_LANES), jnp.float32),
@@ -117,5 +130,5 @@ def decode_attention(q, k_cache, v_cache, lengths,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(jnp.asarray(lengths, jnp.int32), qg, kt, vt)
+    )(jnp.asarray(lengths, jnp.int32), layer_arr, qg, k_cache, v_cache)
     return out.reshape(B, H, D)

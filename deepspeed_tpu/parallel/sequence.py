@@ -157,20 +157,12 @@ def shard_map_attention(mesh, impl="ulysses", axis="sp", causal=True,
     over (dp, tp).  Declaring them keeps shard_map from all-gathering the
     dp-sharded batch onto every device — each device computes only its own
     batch/head shard, with collectives riding the sp axis alone."""
-    import inspect
     from jax.sharding import PartitionSpec as P
-    try:
-        from jax import shard_map as _shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map as _shard_map
-    # the replication-check kwarg was renamed check_rep → check_vma; pick
-    # whichever this jax version accepts
-    sig_params = inspect.signature(_shard_map).parameters
-    check_kw = "check_vma" if "check_vma" in sig_params else "check_rep"
+    from jax import shard_map as _shard_map
 
     def smap(f, **kw):
         return _shard_map(f, mesh=kw["mesh"], in_specs=kw["in_specs"],
-                          out_specs=kw["out_specs"], **{check_kw: False})
+                          out_specs=kw["out_specs"], check_vma=False)
 
     axis_size = int(np.prod([mesh.shape[a] for a in
                              ((axis,) if isinstance(axis, str) else axis)]))

@@ -135,7 +135,7 @@ class CompressedBackend:
         return self.worker_errors[name], self.server_errors[name]
 
     def allreduce(self, name, x):
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         from jax.sharding import PartitionSpec as P
         n = int(np.prod(x.shape))
         we, se = self._buffers(name, n)
@@ -147,7 +147,7 @@ class CompressedBackend:
                 shard_map, mesh=self.mesh,
                 in_specs=(P(), P(axis), P(axis)),
                 out_specs=(P(), P(axis), P(axis)),
-                check_rep=False)
+                check_vma=False)
             def fn(x, we, se):
                 out, nwe, nse = compressed_allreduce(x, we[0], se[0], axis)
                 return out, nwe[None, :], nse[None, :]

@@ -8,14 +8,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:
-    from jax import shard_map
-    _SM = lambda f, mesh, i, o: shard_map(f, mesh=mesh, in_specs=i,
-                                          out_specs=o, check_vma=False)
-except (ImportError, TypeError):
-    from jax.experimental.shard_map import shard_map
-    _SM = lambda f, mesh, i, o: shard_map(f, mesh=mesh, in_specs=i,
-                                          out_specs=o, check_rep=False)
+from jax import shard_map
+
+_SM = lambda f, mesh, i, o: shard_map(f, mesh=mesh, in_specs=i,
+                                      out_specs=o, check_vma=False)
 
 
 def test_reduce_scatter_coalesced(eight_devices):

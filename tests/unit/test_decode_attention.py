@@ -71,3 +71,26 @@ def test_decode_blocked_cache():
     want = np.asarray(xla_cached_attention(
         q[:, None], k, v, jnp.asarray([[L - 1]], jnp.int32)))[:, 0]
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_decode_stacked_layer_indexing():
+    """The layer-stacked cache path (kernel DMAs the layer's blocks via a
+    scalar-prefetch index map — no per-layer slice materializes) is
+    bit-identical to slicing the layer out first."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.transformer.decode_attention import decode_attention
+
+    rng = np.random.default_rng(0)
+    L, B, KVH, S, D, H = 3, 2, 4, 64, 32, 8
+    k = jnp.asarray(rng.standard_normal((L, B, KVH, S, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((L, B, KVH, S, D)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    lengths = jnp.asarray([30, 50], jnp.int32)
+    for li in range(L):
+        stacked = decode_attention(q, k, v, lengths, layer=jnp.asarray(li))
+        sliced = decode_attention(q, k[li], v[li], lengths)
+        np.testing.assert_array_equal(np.asarray(stacked), np.asarray(sliced))
+    # stacked caches demand a layer index
+    with pytest.raises(ValueError):
+        decode_attention(q, k, v, lengths)

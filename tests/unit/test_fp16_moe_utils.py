@@ -67,13 +67,13 @@ def test_fp16_optimizer_overflow_skips_and_rescales():
 # ------------------------------------------------------------------ #
 def test_moe_gather_drop_tokens_roundtrip(eight_devices):
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from deepspeed_tpu.moe.mappings import drop_tokens, gather_tokens
     mesh = Mesh(np.asarray(eight_devices).reshape(8), ("tp",))
     x = jnp.arange(32.0).reshape(8, 4)  # [tokens, dim] split over tp
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(P("tp"),),
-                       out_specs=P("tp"), check_rep=False)
+                       out_specs=P("tp"), check_vma=False)
     def gd(xs):
         full = gather_tokens(xs, "tp", 0)       # every rank: all 32 rows
         return drop_tokens(full, "tp", 0)       # back to this rank's rows
@@ -82,7 +82,7 @@ def test_moe_gather_drop_tokens_roundtrip(eight_devices):
 
     # gradient flows: d/dx of sum(gather(x)) == ones (drop is gather's vjp)
     @functools.partial(shard_map, mesh=mesh, in_specs=(P("tp"),),
-                       out_specs=P("tp"), check_rep=False)
+                       out_specs=P("tp"), check_vma=False)
     def g(xs):
         return jax.grad(lambda y: gather_tokens(y, "tp", 0).sum())(xs)
 

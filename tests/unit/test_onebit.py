@@ -137,7 +137,7 @@ def test_compressed_allreduce_unbiased_over_workers(eight_devices):
     """With different per-worker tensors (sharded batch axis), the decoded
     mean must correlate strongly with the true mean."""
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     import functools
     mesh = Mesh(np.array(eight_devices), ("dp",))
     rng = np.random.default_rng(1)
@@ -145,7 +145,7 @@ def test_compressed_allreduce_unbiased_over_workers(eight_devices):
     true_mean = per_worker.mean(0)
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(P("dp"),),
-                       out_specs=P(), check_rep=False)
+                       out_specs=P(), check_vma=False)
     def run(xs):
         x = xs[0]
         out, _, _ = compressed_allreduce(

@@ -159,7 +159,7 @@ def test_sparse_tensor_roundtrip():
 
 def test_sparse_allreduce(eight_devices):
     import functools
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
     from deepspeed_tpu.runtime.sparse_tensor import SparseTensor, sparse_allreduce
     mesh = Mesh(np.array(eight_devices), ("dp",))
@@ -168,7 +168,7 @@ def test_sparse_allreduce(eight_devices):
     vals = jnp.ones((8, 1, 4), jnp.float32)
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")),
-                       out_specs=(P(), P()), check_rep=False)
+                       out_specs=(P(), P()), check_vma=False)
     def run(i, v):
         st = SparseTensor(i[0], v[0], (10, 4))
         red = sparse_allreduce(st, "dp")

@@ -340,6 +340,10 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
     """
     B, S, H, D = q.shape
     KVH, S_max = k_cache.shape[-3], k_cache.shape[-2]
+    # NOTE: on TPU, f32 matmuls run as multi-pass bf16 on the MXU (jax
+    # default precision), so single-token decode and batched prefill round
+    # differently — logits agree to ~1e-2, not 1e-6.  Hardware numerics,
+    # not a cache bug (the CPU mesh reproduces exact parity).
     if S == 1 and bias is None and window is None:
         # single-token decode: the Pallas online-softmax kernel streams the
         # cache blockwise instead of materializing [B,H,1,S_max] fp32 logits

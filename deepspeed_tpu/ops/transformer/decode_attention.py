@@ -98,14 +98,22 @@ def decode_attention(q, k_cache, v_cache, lengths,
     qg = q.reshape(B, KVH, G, D)
     layer_arr = jnp.asarray([layer if layer is not None else 0], jnp.int32)
 
+    def _live_block(ik, lens, b):
+        # pin indices past the live cache region to the last live block:
+        # Mosaic skips the DMA when a block index repeats, so dead-region
+        # grid steps fetch nothing (their compute is pl.when-gated off too)
+        last = jnp.maximum((lens[b] + block_k - 1) // block_k - 1, 0)
+        return jnp.minimum(ik, last)
+
     if stacked:
         kv_spec = pl.BlockSpec(
             (1, 1, 1, block_k, D),
-            lambda b, h, ik, lens, li: (li[0], b, h, ik, 0))
+            lambda b, h, ik, lens, li: (li[0], b, h,
+                                        _live_block(ik, lens, b), 0))
     else:
         kv_spec = pl.BlockSpec(
             (1, 1, block_k, D),
-            lambda b, h, ik, lens, li: (b, h, ik, 0))
+            lambda b, h, ik, lens, li: (b, h, _live_block(ik, lens, b), 0))
 
     out = pl.pallas_call(
         functools.partial(_decode_kernel, scale=float(scale),

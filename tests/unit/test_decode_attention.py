@@ -94,3 +94,28 @@ def test_decode_stacked_layer_indexing():
     # stacked caches demand a layer index
     with pytest.raises(ValueError):
         decode_attention(q, k, v, lengths)
+
+
+def test_decode_short_lengths_exact():
+    """Dead-region DMA pinning (indices past `lengths` pin to the last live
+    block so Mosaic skips their copies) must not change results, including
+    degenerate lengths and block-boundary lengths."""
+    from deepspeed_tpu.ops.transformer.decode_attention import decode_attention
+
+    rng = np.random.default_rng(0)
+    B, KVH, S, D, H = 4, 4, 256, 32, 4
+    k = jnp.asarray(rng.standard_normal((B, KVH, S, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, KVH, S, D)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    for lens in ([1, 5, 64, 65], [256, 128, 127, 2]):
+        lengths = jnp.asarray(lens, jnp.int32)
+        got = np.asarray(decode_attention(q, k, v, lengths, block_k=64))
+        for b in range(B):
+            for h in range(KVH):
+                s = (np.asarray(q[b, h]) @ np.asarray(k[b, h]).T) / np.sqrt(D)
+                s[lens[b]:] = -np.inf
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                ref = p @ np.asarray(v[b, h])
+                np.testing.assert_allclose(got[b, h], ref, rtol=2e-5,
+                                           atol=2e-5)

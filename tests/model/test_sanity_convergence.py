@@ -13,6 +13,8 @@ the 8-device CPU mesh, bf16 + TP variants.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import jax
 
 import deepspeed_tpu

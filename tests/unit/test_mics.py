@@ -105,6 +105,7 @@ def test_mics_equals_flat_zero_loss_trajectory():
     np.testing.assert_allclose(run(-1), run(2), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_mics_checkpoint_reshards_to_flat_and_back(tmp_path):
     """Save under MiCS (edp=2 × mdp=4), load into a FRESH flat ZeRO-3
     engine (edp=8) and vice versa — values identical, training continues

@@ -26,6 +26,7 @@ def copy_task_batch(rng, bs=8, seq=32):
     return {"input_ids": ids}
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("stage", [1, 3])
 def test_tiny_lm_converges(stage):
     cfg = TransformerConfig(

@@ -151,6 +151,7 @@ def test_fused_qkv_trains_and_infers():
     assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
 
 
+@pytest.mark.slow
 def test_chunked_cross_entropy_matches_full():
     """loss_seq_chunks must reproduce the full-logits loss exactly (same
     nll-sum / valid-count composition), values and gradients."""

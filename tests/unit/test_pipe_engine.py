@@ -137,6 +137,7 @@ def test_pipeline_postln_matches_dense_loss_at_init():
     assert abs(loss - np.log(64)) < 0.8
 
 
+@pytest.mark.slow
 def test_pipeline_memory_bounded_chunks():
     """``pipeline.max_in_flight_microbatches`` gives the reference 1F1B
     schedule's memory property (``schedule.py:189``): peak temp memory is

@@ -293,6 +293,23 @@ def send_recv_prev(tensor, group):
     return ppermute(tensor, group, [(i, (i - 1) % n) for i in range(n)])
 
 
+def p2p(tensor, src, dst, group):
+    """Rank-addressed point-to-point as ONE collective: the SPMD rendering
+    of a reference ``send(dst)`` / ``recv(src)`` pair (``comm.py:428``).
+    Every device calls it; device ``dst`` returns ``src``'s value, all
+    others return their own tensor unchanged.  Runs inside
+    ``shard_map``/``jit`` like every device collective here."""
+    if not any(_is_traced(l) for l in jax.tree.leaves(tensor)):
+        raise RuntimeError("p2p is a device collective: call inside "
+                           "shard_map/jit")
+    axes = _axes(group)
+    assert len(axes) == 1, "p2p takes a single axis"
+    moved = ppermute(tensor, group, [(src, dst)])
+    idx = lax.axis_index(axes[0])
+    return jax.tree.map(
+        lambda m, t: jnp.where(idx == dst, m, t), moved, tensor)
+
+
 @timed_op
 def broadcast(tensor, src=0, group=None, log_name=None):
     """Traced: everyone takes src's value via a masked psum.  Eager on global
@@ -356,13 +373,16 @@ def isend(tensor, dst, group=None, tag=0):
     """Point-to-point verbs (reference ``comm.py:420`` isend/irecv,
     ``:428`` send/recv) are NOT supported as standalone eager ops on TPU —
     this always raises with guidance.  Rank-addressed p2p has no XLA analog
-    outside a compiled collective: use :func:`ppermute` /
-    :func:`send_recv_next` / :func:`send_recv_prev` inside ``shard_map``
-    (both halves of each exchange are one collective-permute riding ICI,
-    which is how the pipeline engine moves activations)."""
+    outside a compiled collective: the one-call SPMD equivalent of a
+    send/recv PAIR is :func:`p2p` (or :func:`ppermute` /
+    :func:`send_recv_next` / :func:`send_recv_prev`) inside ``shard_map``
+    — both halves of each exchange are one collective-permute riding ICI,
+    which is how the pipeline engine moves activations."""
     raise NotImplementedError(
-        "isend/irecv/send/recv have no eager analog on TPU: use ppermute / "
-        "send_recv_next inside shard_map (pipeline p2p rides ICI)")
+        "isend/irecv/send/recv have no eager analog on TPU: call "
+        "dist.p2p(tensor, src, dst, group) — the send/recv pair as ONE "
+        "collective — or ppermute/send_recv_next inside shard_map "
+        "(pipeline p2p rides ICI)")
 
 
 irecv = isend

@@ -49,12 +49,18 @@ def pytest_collection_modifyitems(config, items):
     invocation asks for them."""
     if config.getoption("--run-slow") or config.option.markexpr:
         return
-    if any("::" in a for a in config.args):
-        return      # running explicitly-named tests: honor the request
+    # explicitly-named node IDs run even when slow — but only THOSE items,
+    # not every slow test swept up by other path arguments in the same run
+    explicit = [a for a in config.args if "::" in a]
+
+    def _named(item):
+        return any(item.nodeid == a or item.nodeid.startswith(a + "[")
+                   or item.nodeid.startswith(a + "::") for a in explicit)
+
     skip = pytest.mark.skip(
         reason="slow (nightly tier); use --run-slow or -m slow")
     for item in items:
-        if "slow" in item.keywords:
+        if "slow" in item.keywords and not _named(item):
             item.add_marker(skip)
 
 

@@ -135,3 +135,20 @@ def test_monitored_barrier_and_inference_all_reduce(topo):
 def test_isend_raises_with_guidance():
     with pytest.raises(NotImplementedError):
         dist.isend(jnp.ones(4), dst=1)
+
+
+def test_p2p_single_pair(topo):
+    """dist.p2p: the reference send/recv pair as ONE collective — dst gets
+    src's value, everyone else keeps their own."""
+    x = jnp.arange(8.0)
+    out = _run_collective(
+        topo, lambda v: dist.p2p(v, src=2, dst=5, group=(EDP_AXIS,)),
+        x, P(EDP_AXIS), P(EDP_AXIS))
+    want = np.arange(8.0)
+    want[5] = 2.0
+    np.testing.assert_allclose(np.asarray(out), want)
+
+
+def test_send_raises_with_p2p_guidance(topo):
+    with pytest.raises(NotImplementedError, match="p2p"):
+        dist.send(jnp.zeros(4), dst=1)

@@ -303,7 +303,14 @@ def p2p(tensor, src, dst, group):
         raise RuntimeError("p2p is a device collective: call inside "
                            "shard_map/jit")
     axes = _axes(group)
-    assert len(axes) == 1, "p2p takes a single axis"
+    if len(axes) != 1:
+        raise ValueError(f"p2p takes a single mesh axis, got {axes}")
+    n = get_world_size(axes)
+    if not (0 <= src < n and 0 <= dst < n):
+        # an out-of-range endpoint would make the ppermute deliver nothing
+        # and the masked merge silently keep every device's own tensor
+        raise ValueError(f"p2p src={src}/dst={dst} out of range for axis "
+                         f"{axes[0]!r} of size {n}")
     moved = ppermute(tensor, group, [(src, dst)])
     idx = lax.axis_index(axes[0])
     return jax.tree.map(

@@ -131,7 +131,9 @@ class InferenceEngine:
     def load_checkpoint(self, path, tag=None):
         """Directory → engine (Orbax) checkpoint; single file → a
         ``save_16bit_model`` export (safetensors / torch state dict with
-        flax-named keys; legacy pickled pytrees still load).  HF-named
+        flax-named keys).  Files needing full (code-executing) unpickling —
+        legacy pickled pytrees, torch files with non-allowlisted objects —
+        only load with ``DSTPU_ALLOW_PICKLE_CHECKPOINTS=1``.  HF-named
         exports (``hf_policy=...``) go through ``module_inject`` instead."""
         import os, pickle
         if os.path.isfile(path):
@@ -141,15 +143,36 @@ class InferenceEngine:
                 return
             sd = None
             try:
+                # weights_only=True: never execute pickled code from an
+                # untrusted checkpoint during format probing
                 import torch
-                sd = torch.load(path, map_location="cpu")
+                sd = torch.load(path, map_location="cpu", weights_only=True)
             except (pickle.UnpicklingError, RuntimeError, ImportError):
-                pass                     # not a torch file → legacy pickle
+                pass                     # not a weights-only-loadable file
             if sd is not None:
                 self.set_params(_unflatten_flax_paths(
                     {k: (v.float().numpy() if hasattr(v, "numpy") else v)
                      for k, v in sd.items()}))
                 return
+            # full unpickling executes arbitrary code — only for files the
+            # operator explicitly vouches for
+            if os.environ.get("DSTPU_ALLOW_PICKLE_CHECKPOINTS") != "1":
+                raise ValueError(
+                    f"{path}: not loadable with weights_only unpickling; "
+                    "full pickle execution is disabled for untrusted files. "
+                    "Set DSTPU_ALLOW_PICKLE_CHECKPOINTS=1 to load a legacy "
+                    "pickled pytree (or a torch file with non-allowlisted "
+                    "objects) you trust.")
+            try:                         # torch-zip file with custom objects
+                import torch
+                sd = torch.load(path, map_location="cpu", weights_only=False)
+                self.set_params(_unflatten_flax_paths(
+                    {k: (v.float().numpy() if hasattr(v, "numpy") else v)
+                     for k, v in sd.items()}))
+                return
+            except (pickle.UnpicklingError, RuntimeError, ImportError,
+                    ValueError):
+                pass                     # bare pickle stream → legacy path
             with open(path, "rb") as f:
                 self.set_params(pickle.load(f))
             return

@@ -556,12 +556,14 @@ class DeepSpeedEngine:
         self._lazy_init(args, kwargs)
         args = tuple(self._curriculum_slice(a, 1) if _is_batch_like(a) else a
                      for a in args)
-        # capture the batch AFTER curriculum slicing so the profiled program
-        # has the shapes the step actually runs
-        self._maybe_start_profiler(
-            next((a for a in args if _is_batch_like(a)), None))
         kwargs = {k: self._curriculum_slice(v, 1) if _is_batch_like(v) else v
                   for k, v in kwargs.items()}
+        # capture the batch AFTER curriculum slicing so the profiled program
+        # has the shapes the step actually runs; the batch may arrive as a
+        # positional OR a keyword argument
+        self._maybe_start_profiler(
+            next((a for a in (*args, *kwargs.values())
+                  if _is_batch_like(a)), None))
         args = tuple(self.put_batch(a) if _is_batch_like(a) else a for a in args)
         kwargs = {k: self.put_batch(v) if _is_batch_like(v) else v
                   for k, v in kwargs.items()}

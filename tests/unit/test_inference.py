@@ -160,3 +160,38 @@ def test_weight_quantized_inference():
     assert sum(l.q.nbytes for l in q4) < sum(i8.values())
     got4 = np.asarray(eng4.forward(ids))
     assert np.isfinite(got4).all()
+
+
+def test_untrusted_pickle_checkpoint_gated(model_and_params, tmp_path,
+                                           monkeypatch):
+    """Single-file checkpoint probing must never execute pickled code
+    (reference loads checkpoints via torch.load; here weights_only probing
+    plus an explicit opt-in gate for legacy pickled pytrees)."""
+    import os
+    import pickle
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    model, params, ids = model_and_params
+    eng = InferenceEngine(model, DeepSpeedInferenceConfig(dtype="float32"))
+
+    marker = tmp_path / "pwned"
+    class Evil:
+        def __reduce__(self):
+            return (os.system, (f"touch {marker}",))
+    evil = tmp_path / "evil.pt"
+    with open(evil, "wb") as f:
+        pickle.dump({"x": Evil()}, f)
+    monkeypatch.delenv("DSTPU_ALLOW_PICKLE_CHECKPOINTS", raising=False)
+    with pytest.raises(ValueError, match="weights_only"):
+        eng.load_checkpoint(str(evil))
+    assert not marker.exists(), "pickled code executed during probing"
+
+    # a trusted legacy pickled pytree loads only with the explicit opt-in
+    legacy = tmp_path / "legacy.pkl"
+    with open(legacy, "wb") as f:
+        pickle.dump(jax.tree.map(np.asarray, params), f)
+    with pytest.raises(ValueError, match="DSTPU_ALLOW_PICKLE_CHECKPOINTS"):
+        eng.load_checkpoint(str(legacy))
+    monkeypatch.setenv("DSTPU_ALLOW_PICKLE_CHECKPOINTS", "1")
+    eng.load_checkpoint(str(legacy))
+    assert np.asarray(eng.forward(ids)).shape[0] == ids.shape[0]

@@ -98,19 +98,34 @@ def train_bench(model_name, *, micro_bs, zero_stage, steps, seq=2048,
 
 
 def decode_bench(model_name="opt-1.3b", *, batch_size=16, prompt=256,
-                 gen=256):
+                 gen=256, int8=False):
     """DS-Chat generation-phase workload (prompt 256 + gen 256) through the
     jitted prefill+decode program (reference Hybrid Engine `generate`,
-    ``blogs/deepspeed-chat/README.md:265``)."""
+    ``blogs/deepspeed-chat/README.md:265``).  ``int8=True`` runs the
+    per-channel INT8-at-rest weight path (reference
+    ``runtime/weight_quantizer.py``); layers are unrolled
+    (``scan_layers=False``) — scanning the trunk dynamic-slices a relayout
+    copy of each layer's qkv weights per token.
+
+    ``hbm_utilization`` is estimated traffic / peak bandwidth: weight bytes
+    once per decode step plus the KV blocks the Pallas decode kernel
+    actually DMAs (live blocks only, at its block_k granularity)."""
     import jax
     from deepspeed_tpu.models.opt import opt_config
     from deepspeed_tpu.models.transformer import Transformer
     from deepspeed_tpu.inference.engine import InferenceEngine
     from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.ops.transformer.decode_attention import \
+        DEFAULT_BLOCK_K_DECODE
+    from deepspeed_tpu.profiling.flops_profiler.profiler import \
+        device_peak_hbm_gbps
 
-    cfg = opt_config(model_name, max_seq_len=prompt + gen, dtype="bfloat16")
+    cfg = opt_config(model_name, max_seq_len=prompt + gen, dtype="bfloat16",
+                     scan_layers=False)
     model = Transformer(cfg)
-    eng = InferenceEngine(model, DeepSpeedInferenceConfig(dtype="bfloat16"))
+    quant = {"enabled": True, "bits": 8, "per_channel": True} if int8 else {}
+    eng = InferenceEngine(model, DeepSpeedInferenceConfig(
+        dtype="bfloat16", quant=quant))
     eng.init_params()
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch_size, prompt)).astype(np.int32)
@@ -128,13 +143,31 @@ def decode_bench(model_name="opt-1.3b", *, batch_size=16, prompt=256,
     if dt_full > dt_half:
         decode_rate = round(batch_size * (gen - gen // 2)
                             / (dt_full - dt_half) / jax.device_count(), 1)
+        # estimated HBM traffic per decode step: all params once + the live
+        # KV blocks (the kernel skips blocks past the cache's live region)
+        param_bytes = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                          for l in jax.tree.leaves(eng.params))
+        bk = min(DEFAULT_BLOCK_K_DECODE, prompt + gen)
+        steps = np.arange(gen // 2, gen)        # the measured decode steps
+        live_blocks = np.ceil((prompt + steps + 1) / bk)
+        kv_row = cfg.kv_heads * cfg.head_dim * 2        # bf16 bytes per pos
+        cache_bytes = 2 * cfg.num_layers * batch_size * kv_row * bk \
+            * float(np.mean(live_blocks))
+        step_t = (dt_full - dt_half) / (gen - gen // 2)
+        # per-chip: traffic spreads over all chips (params replicated reads
+        # + the batch's KV shards), so normalize both sides per device
+        hbm_util = (param_bytes + cache_bytes) / jax.device_count() \
+            / step_t / (device_peak_hbm_gbps() * 1e9)
     else:
         decode_rate = None      # timing inversion: measurement invalid
+        hbm_util = None
     return {
         "model": model_name,
+        "weights": "int8-per-channel" if int8 else "bf16",
         "decode_tokens_per_sec_chip": decode_rate,
         "e2e_tokens_per_sec_chip": round(batch_size * gen / dt_full
                                          / jax.device_count(), 1),
+        "hbm_utilization": round(hbm_util, 3) if hbm_util else None,
         "batch_size": batch_size,
         "prompt_len": prompt,
         "gen_len": gen,
@@ -198,8 +231,10 @@ def main():
     # (2) regression guard: OPT-350M, reference-exact fp32 master/moments
     guard = train_bench("opt-350m", micro_bs=4, zero_stage=1, steps=steps)
     _phase_cleanup()
-    # (3) DS-Chat generation phase
+    # (3) DS-Chat generation phase: bf16 weights + per-channel INT8-at-rest
     dec = decode_bench("opt-1.3b")
+    _phase_cleanup()
+    dec_int8 = decode_bench("opt-1.3b", int8=True)
 
     result = {
         "metric": "opt-1.3b-sft-tokens/sec/chip(seq2048,bs2,zero3,"
@@ -212,8 +247,15 @@ def main():
         "step_time_s": north["step_time_s"],
         "loss": north["loss"],
         "n_devices": jax.device_count(),
+        # honesty: on one chip the zero/dp mesh axes are size-1, so the
+        # zero3 label shards nothing here — real ZeRO-3 collectives are
+        # exercised on the virtual multi-device mesh (tests + driver dryrun)
+        "sharding_note": ("single-chip: zero/dp axes size-1 (nominal); "
+                          "multi-device sharding covered by dryrun_multichip"
+                          if jax.device_count() == 1 else None),
         "sft_350m_guard": guard,
         "generation": dec,
+        "generation_int8": dec_int8,
     }
     print(json.dumps(result))
 

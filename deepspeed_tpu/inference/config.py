@@ -36,6 +36,11 @@ class QuantizationConfig(DeepSpeedConfigModel):
     enabled: bool = False
     bits: int = 8
     group_size: int = 64
+    # per-output-channel scales (int8 only): the dequant is a bare
+    # convert×broadcast that XLA fuses into the consuming matmul, so decode
+    # streams int8 weights from HBM (groupwise reshape chains materialize a
+    # bf16 copy of every weight each decode step instead)
+    per_channel: bool = False
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):

@@ -12,8 +12,10 @@ kernels (``_apply_injection_policy :408``), slices weights for TP
 * TP weight slicing is a sharding plan (AutoTP name rules,
   ``runtime/zero/partition.py``) applied as param ``NamedSharding``s — XLA
   inserts the per-layer collectives the reference codes by hand;
-* the KV cache is a donated, statically-shaped [L, B, KVH, S_max, D] buffer
-  updated in-place via donation (the workspace allocator equivalent);
+* the KV cache is a donated, statically-shaped [L, B, S_max, KVH*D] buffer
+  (S-major, heads flattened — the decode kernel's full-lane-width DMA
+  layout) updated in-place via donation (the workspace allocator
+  equivalent);
 * CUDA-graph capture/replay == jit compile/execute — every step after the
   first runs from the executable cache.
 
@@ -56,7 +58,8 @@ class InferenceEngine:
                 WeightQuantization)
             self._quantizer = WeightQuantization(
                 bits=self._config.quant.bits,
-                group_size=self._config.quant.group_size)
+                group_size=self._config.quant.group_size,
+                per_channel=self._config.quant.per_channel)
         self._params = None
         self._compiled = {}
         self._rng = jax.random.key(0)

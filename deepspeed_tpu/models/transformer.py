@@ -329,17 +329,18 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
                      window=None, layer=None):
     """Decode attention against a KV cache.
 
-    q: [B, S, H, D]; caches: [B, KVH, S_max, D] (head-major) — or, with
-    ``layer`` given, the FULL layer-stacked [L, B, KVH, S_max, D] cache
-    (the Pallas kernel indexes the layer itself; no per-layer slice is
-    materialized).  q_positions: [B, S] absolute positions.  KV entries at
-    positions > q_pos are masked — this covers both causality and the
-    unwritten cache tail.  TPU-native analog of the reference
-    ``softmax_context`` KV-cache op
+    q: [B, S, H, D]; caches: [B, S_max, KVH*D] (S-major, heads flattened —
+    the decode kernel's full-lane-width DMA layout; the cache write is the
+    raw projection output) — or, with ``layer`` given, the FULL
+    layer-stacked [L, B, S_max, KVH*D] cache (the Pallas kernel indexes the
+    layer itself; no per-layer slice is materialized).  q_positions: [B, S]
+    absolute positions.  KV entries at positions > q_pos are masked — this
+    covers both causality and the unwritten cache tail.  TPU-native analog
+    of the reference ``softmax_context`` KV-cache op
     (``csrc/transformer/inference/csrc/pt_binding.cpp``).
     """
     B, S, H, D = q.shape
-    KVH, S_max = k_cache.shape[-3], k_cache.shape[-2]
+    S_max, KVH = k_cache.shape[-2], k_cache.shape[-1] // D
     # NOTE: on TPU, f32 matmuls run as multi-pass bf16 on the MXU (jax
     # default precision), so single-token decode and batched prefill round
     # differently — logits agree to ~1e-2, not 1e-6.  Hardware numerics,
@@ -361,6 +362,9 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
                                                keepdims=False)
         v_cache = jax.lax.dynamic_index_in_dim(v_cache, layer, 0,
                                                keepdims=False)
+    # [B, S_max, KVH*D] → head-major [B, KVH, S_max, D] for the einsum
+    k_cache = k_cache.reshape(B, S_max, KVH, D).transpose(0, 2, 1, 3)
+    v_cache = v_cache.reshape(B, S_max, KVH, D).transpose(0, 2, 1, 3)
     if KVH != H:
         rep = H // KVH
         k_cache = jnp.repeat(k_cache, rep, axis=1)
@@ -424,14 +428,15 @@ class Attention(nn.Module):
                     "sparse_attention model decoding with dense KV-cache "
                     "attention — train/decode attention patterns differ")
             # write this step's k/v at the current position, attend over
-            # cache; cache layout is [B, KVH, S_max, D] (head-major so the
-            # decode kernel blocks the seq dim with NO relayout of the
-            # full cache — only the new S_step tokens transpose)
+            # cache; cache layout is [.., S_max, KVH*D] (S-major, heads
+            # flattened — the decode kernel's full-lane-width DMA layout;
+            # the write below is the raw projection output, no transpose)
             start = positions[0, 0]
-            k_new = k.transpose(0, 2, 1, 3)
-            v_new = v.transpose(0, 2, 1, 3)
+            B_, S_ = k.shape[0], k.shape[1]
+            k_new = k.reshape(B_, S_, KVH * D)
+            v_new = v.reshape(B_, S_, KVH * D)
             if "layer" in cache:
-                # stacked-carry decode: the FULL [L, B, KVH, S_max, D]
+                # stacked-carry decode: the FULL [L, B, S_max, KVH*D]
                 # cache rides the layer-scan carry and only this step's
                 # tokens are written — never a full-cache rewrite per
                 # token (the nn.scan ys path re-materialized ~the whole
@@ -440,20 +445,20 @@ class Attention(nn.Module):
                 li = cache["layer"]
                 k_full = jax.lax.dynamic_update_slice(
                     cache["k"], k_new[None].astype(cache["k"].dtype),
-                    (li, 0, 0, start, 0))
+                    (li, 0, start, 0))
                 v_full = jax.lax.dynamic_update_slice(
                     cache["v"], v_new[None].astype(cache["v"].dtype),
-                    (li, 0, 0, start, 0))
+                    (li, 0, start, 0))
                 out = cached_attention(q, k_full, v_full, positions,
                                        bias=bias, window=window, layer=li)
                 new_cache = {"k": k_full, "v": v_full, "layer": li}
             else:
                 k_cache = jax.lax.dynamic_update_slice(
                     cache["k"], k_new.astype(cache["k"].dtype),
-                    (0, 0, start, 0))
+                    (0, start, 0))
                 v_cache = jax.lax.dynamic_update_slice(
                     cache["v"], v_new.astype(cache["v"].dtype),
-                    (0, 0, start, 0))
+                    (0, start, 0))
                 new_cache = {"k": k_cache, "v": v_cache}
                 out = cached_attention(q, k_cache, v_cache, positions,
                                        bias=bias, window=window)
@@ -711,13 +716,14 @@ class Transformer(nn.Module):
         return self._head(h), new_cache
 
     def init_cache(self, batch_size, max_len, dtype=None):
-        """Zero KV cache: [L, B, KVH, max_len, D] per k/v (layer-stacked for
-        the scanned trunk; head-major so decode blocks the seq dim without
-        relayout)."""
+        """Zero KV cache: [L, B, max_len, KVH*D] per k/v (layer-stacked for
+        the scanned trunk; S-major with flattened heads so decode cache
+        writes are the raw projection output and the decode kernel's KV
+        DMAs are contiguous full-lane-width slabs)."""
         cfg = self.config
         dtype = dtype or cfg.jnp_dtype
-        shape = (cfg.num_layers, batch_size, cfg.kv_heads, max_len,
-                 cfg.head_dim)
+        shape = (cfg.num_layers, batch_size, max_len,
+                 cfg.kv_heads * cfg.head_dim)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
     def __call__(self, batch):

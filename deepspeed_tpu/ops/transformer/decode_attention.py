@@ -5,16 +5,38 @@ TPU-native equivalent of the reference's ``softmax_context`` inference op
 half of its decode pipeline).  Single-token decode: one query row per
 (batch, head) attends over the cache.
 
-Kernel layout: the HEAD dim rides the sublanes — per (batch, kv-head) grid
-cell the query block is [G, D] (G = query heads per kv head; MHA → G per
-block of heads), so the QK^T matmul is [G, D] × [D, bk] on the MXU instead
-of a degenerate [1, D] row.  The KV length mask (cache tail + causality for
-a single new token collapse to ``pos < length``) is applied per block, and
-an online softmax accumulates across KV blocks so the cache never
-materializes an S_max-wide probability row in fp32 HBM.
+Kernel layout (v4, bandwidth-first): decode attention moves ~1.6 GB of KV
+cache per token-step at OPT-1.3B/bs16 and does almost no math, so everything
+is shaped for DMA efficiency, not MXU occupancy:
+
+* The cache is **S-major with flattened heads** — ``[B, S_max, KVH*D]``
+  (optionally layer-stacked ``[L, ...]``).  A KV block is then a fully
+  contiguous ``[block_k, KVH*D]`` slab whose minor dim (e.g. 2048) is a
+  whole number of 128-lane tiles, so the HBM→VMEM DMA streams at full
+  width.  The previous head-major ``[B, KVH, S, D]`` layout produced
+  D(=64)-lane-minor blocks that pad to 128 lanes in VMEM — half the
+  effective bandwidth — and its per-(batch, head) grid added ~0.6 µs of
+  overhead per 64 KB sliver.  Bonus: the decode-step cache write is the raw
+  projection output (no per-token transpose at all).
+* One grid cell covers ALL kv heads of one (batch row, kv block).  Per-head
+  score matmuls are fused into ONE MXU matmul via a **block-diagonal Q**:
+  rows = query heads, row h*G+g carries q[h,g] in columns h*D:(h+1)*D and
+  zeros elsewhere, so ``Q_bd @ K_slab^T`` lands exactly the per-head scores
+  [H, block_k] (the MXU multiplies zeros for free — it is idle here anyway).
+  ``P @ V_slab`` similarly yields [H, KVH*D] from which each head's D-column
+  diagonal block is accumulated.
+* Online softmax runs once per cell over the whole [H, block_k] score tile
+  in fp32 scratch, so the cache never materializes an S_max-wide
+  probability row in fp32 HBM.
+
+The KV length mask (cache tail + causality for a single new token collapse
+to ``pos < length``) is applied per block, and blocks entirely past the
+live cache region are skipped: their block index is pinned to the last live
+block (Mosaic elides the repeated DMA) and their compute is pl.when-gated.
 """
 
 import functools
+import os as _os
 
 import numpy as np
 
@@ -26,76 +48,90 @@ from jax.experimental.pallas import tpu as pltpu
 from deepspeed_tpu.ops.transformer.flash_attention import (LSE_LANES, NEG_INF,
                                                            _interpret)
 
-DEFAULT_BLOCK_K_DECODE = 512
+DEFAULT_BLOCK_K_DECODE = int(_os.environ.get("DSTPU_DECODE_BLOCK_K", "512"))
 
 
 def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, scale, block_k, nk, stacked):
+                   m_scr, l_scr, acc_scr, qbd_scr, *, scale, block_k, nk,
+                   kvh, g, d, stacked):
     b = pl.program_id(0)
-    ik = pl.program_id(2)
+    ik = pl.program_id(1)
 
     @pl.when(ik == 0)
     def _init():
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
+        # build the block-diagonal Q once per batch row
+        qbd_scr[:] = jnp.zeros_like(qbd_scr)
+        q = q_ref[0]                                     # [H, D]
+        for h in range(kvh):
+            qbd_scr[h * g:(h + 1) * g, h * d:(h + 1) * d] = \
+                q[h * g:(h + 1) * g]
 
     length = len_ref[b]
     # skip KV blocks entirely past the live cache region
     @pl.when(ik * block_k < length)
     def _body():
-        q = q_ref[0, 0]                                  # [G, D]
-        k = k_ref[0, 0, 0] if stacked else k_ref[0, 0]   # [bk, D]
-        v = v_ref[0, 0, 0] if stacked else v_ref[0, 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        k = k_ref[0, 0] if stacked else k_ref[0]         # [bk, KVH*D]
+        v = v_ref[0, 0] if stacked else v_ref[0]
+        # all heads' scores in ONE matmul (see module docstring)
+        s = jax.lax.dot_general(qbd_scr[:], k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         pos = ik * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)                  # [1, bk]
-        s = jnp.where(pos < length, s, NEG_INF)          # cache tail mask
+        live = pos < length                              # cache tail mask
+        s = jnp.where(live, s, NEG_INF)                  # [H, bk]
         m_prev = m_scr[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
-        p = jnp.where(pos < length, p, 0.0)
+        p = jnp.where(live, p, 0.0)
         corr = jnp.exp(m_prev - m_new)
         l_scr[:] = jnp.broadcast_to(
             l_scr[:, 0:1] * corr + jnp.sum(p, axis=1, keepdims=True),
             l_scr.shape)
-        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        o_flat = jax.lax.dot_general(p.astype(v.dtype), v,
+                                     (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        # accumulate each head's D-column diagonal block of [H, KVH*D]
+        for h in range(kvh):
+            rows = slice(h * g, (h + 1) * g)
+            acc_scr[rows] = (acc_scr[rows] * corr[rows]
+                             + o_flat[rows, h * d:(h + 1) * d])
 
     @pl.when(ik == nk - 1)
     def _finish():
         l = l_scr[:, 0:1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
 
 
 def decode_attention(q, k_cache, v_cache, lengths,
                      scale=None, block_k=DEFAULT_BLOCK_K_DECODE, layer=None):
     """Single-token decode attention.
 
-    q: [B, H, D] (this step's query); caches: [B, KVH, S_max, D]
-    (head-major — the model stores them this way so NO cache relayout
-    happens per decode step), or the FULL layer-stacked
-    [L, B, KVH, S_max, D] cache with ``layer`` a (traced) layer index —
-    the kernel's index maps then DMA only this layer's blocks, so the
-    caller never materializes a per-layer slice of the stacked cache.
+    q: [B, H, D] (this step's query); caches: [B, S_max, KVH*D]
+    (S-major, heads flattened into lanes — the layout the model stores, so
+    the cache write is the raw projection output and the kernel's KV DMAs
+    are contiguous full-lane-width slabs), or the FULL layer-stacked
+    [L, B, S_max, KVH*D] cache with ``layer`` a (traced) layer index — the
+    kernel's index maps then DMA only this layer's blocks, so the caller
+    never materializes a per-layer slice of the stacked cache.
     lengths: [B] int32 — number of valid cache entries INCLUDING this
     step's freshly-written position.  Returns [B, H, D].
     """
     B, H, D = q.shape
-    stacked = k_cache.ndim == 5
+    stacked = k_cache.ndim == 4
     if stacked and layer is None:
         raise ValueError("stacked [L, ...] caches require layer=")
-    KVH, S_max = k_cache.shape[-3], k_cache.shape[-2]
+    S_max, KVHD = k_cache.shape[-2], k_cache.shape[-1]
+    KVH = KVHD // D
     G = H // KVH                                         # query heads per kv head
     if scale is None:
         scale = 1.0 / float(np.sqrt(D))
     block_k = min(block_k, S_max)
     nk = pl.cdiv(S_max, block_k)
-    qg = q.reshape(B, KVH, G, D)
     layer_arr = jnp.asarray([layer if layer is not None else 0], jnp.int32)
 
     def _live_block(ik, lens, b):
@@ -107,36 +143,44 @@ def decode_attention(q, k_cache, v_cache, lengths,
 
     if stacked:
         kv_spec = pl.BlockSpec(
-            (1, 1, 1, block_k, D),
-            lambda b, h, ik, lens, li: (li[0], b, h,
-                                        _live_block(ik, lens, b), 0))
+            (1, 1, block_k, KVHD),
+            lambda b, ik, lens, li: (li[0], b, _live_block(ik, lens, b), 0))
     else:
         kv_spec = pl.BlockSpec(
-            (1, 1, block_k, D),
-            lambda b, h, ik, lens, li: (b, h, _live_block(ik, lens, b), 0))
+            (1, block_k, KVHD),
+            lambda b, ik, lens, li: (b, _live_block(ik, lens, b), 0))
 
     out = pl.pallas_call(
         functools.partial(_decode_kernel, scale=float(scale),
-                          block_k=block_k, nk=nk, stacked=stacked),
+                          block_k=block_k, nk=nk, kvh=KVH, g=G, d=D,
+                          stacked=stacked),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(B, KVH, nk),
+            grid=(B, nk),
             in_specs=[
-                pl.BlockSpec((1, 1, G, D),
-                             lambda b, h, ik, lens, li: (b, h, 0, 0)),
+                pl.BlockSpec((1, H, D), lambda b, ik, lens, li: (b, 0, 0)),
                 kv_spec,
                 kv_spec,
             ],
-            out_specs=pl.BlockSpec((1, 1, G, D),
-                                   lambda b, h, ik, lens, li: (b, h, 0, 0)),
+            out_specs=pl.BlockSpec((1, H, D),
+                                   lambda b, ik, lens, li: (b, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((G, LSE_LANES), jnp.float32),
-                pltpu.VMEM((G, LSE_LANES), jnp.float32),
-                pltpu.VMEM((G, D), jnp.float32),
+                pltpu.VMEM((H, LSE_LANES), jnp.float32),
+                pltpu.VMEM((H, LSE_LANES), jnp.float32),
+                pltpu.VMEM((H, D), jnp.float32),
+                pltpu.VMEM((H, KVHD), q.dtype),
             ]),
-        out_shape=jax.ShapeDtypeStruct((B, KVH, G, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "arbitrary"),
+            # the [block_k, KVH*D] K/V slabs double-buffer; the default
+            # 16 MB scoped-vmem budget is a hair short at the default
+            # block_k, and DSTPU_DECODE_BLOCK_K can grow the slabs further —
+            # size the budget from the actual blocks (4 slab buffers +
+            # scratch/q/out headroom)
+            vmem_limit_bytes=max(
+                64 * 1024 * 1024,
+                4 * block_k * KVHD * q.dtype.itemsize + 8 * 1024 * 1024)),
         interpret=_interpret(),
-    )(jnp.asarray(lengths, jnp.int32), layer_arr, qg, k_cache, v_cache)
-    return out.reshape(B, H, D)
+    )(jnp.asarray(lengths, jnp.int32), layer_arr, q, k_cache, v_cache)
+    return out

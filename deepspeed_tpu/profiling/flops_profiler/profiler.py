@@ -40,14 +40,32 @@ PEAK_TFLOPS = {
     "cpu": 0.1,
 }
 
+# Peak HBM GB/s per chip for bandwidth-utilization estimates (public figures).
+PEAK_HBM_GBPS = {
+    "tpu v4": 1228.0,
+    "tpu v5 lite": 819.0,   # v5e
+    "tpu v5e": 819.0,
+    "tpu v5": 2765.0,       # v5p
+    "tpu v6 lite": 1640.0,  # trillium
+    "cpu": 50.0,
+}
 
-def device_peak_tflops():
+
+def _device_peak(table, default):
     d = jax.devices()[0]
     kind = getattr(d, "device_kind", "cpu").lower()
-    for key, val in PEAK_TFLOPS.items():
+    for key, val in table.items():
         if kind.startswith(key):
             return val
-    return PEAK_TFLOPS.get(d.platform, 100.0)
+    return table.get(d.platform, default)
+
+
+def device_peak_tflops():
+    return _device_peak(PEAK_TFLOPS, 100.0)
+
+
+def device_peak_hbm_gbps():
+    return _device_peak(PEAK_HBM_GBPS, 819.0)
 
 
 def cost_analysis_of(fn, *args, **kwargs):

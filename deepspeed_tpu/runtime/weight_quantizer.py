@@ -30,17 +30,19 @@ class QuantizedWeight:
     must read the tensor's OWN metadata, not the deserializing quantizer's
     settings)."""
 
-    def __init__(self, q, scale, zero, shape, bits, symmetric=True):
+    def __init__(self, q, scale, zero, shape, bits, symmetric=True,
+                 per_channel=False):
         self.q = q
         self.scale = scale
         self.zero = zero
         self.shape = tuple(shape)
         self.bits = int(bits)
         self.symmetric = bool(symmetric)
+        self.per_channel = bool(per_channel)
 
     def tree_flatten(self):
         return ((self.q, self.scale, self.zero),
-                (self.shape, self.bits, self.symmetric))
+                (self.shape, self.bits, self.symmetric, self.per_channel))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -73,9 +75,13 @@ class WeightQuantization:
 
     def __init__(self, bits=8, group_size=64, symmetric=True, min_ndim=2,
                  mlp_extra_grouping=False, mp_size=1,
-                 skip_patterns=DEFAULT_SKIP_PATTERNS):
+                 skip_patterns=DEFAULT_SKIP_PATTERNS, per_channel=False):
         if bits not in (4, 8):
             raise ValueError(f"bits must be 4 or 8, got {bits}")
+        if per_channel and (bits != 8 or not symmetric):
+            raise ValueError("per_channel quantization supports symmetric "
+                             "int8 only")
+        self.per_channel = bool(per_channel)
         if group_size < 2:
             raise ValueError(f"group_size must be >= 2, got {group_size}")
         if group_size % 2:
@@ -112,6 +118,19 @@ class WeightQuantization:
 
     def quantize_leaf(self, leaf):
         x = jnp.asarray(leaf)
+        if self.per_channel:
+            # symmetric int8, one scale per output channel (all axes but the
+            # leading contraction axis).  The point is the DEQUANT shape: a
+            # bare ``q.astype(dtype) * scale`` with no reshape/pad lets XLA
+            # fuse the dequant into the consuming matmul, so decode streams
+            # int8 from HBM — the groupwise path's reshape chains
+            # re-materialize a bf16 copy of every weight per decode step.
+            xf = x.astype(jnp.float32)
+            absmax = jnp.max(jnp.abs(xf), axis=0, keepdims=True)
+            scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+            q = jnp.clip(jnp.round(xf / scale), -128, 127).astype(jnp.int8)
+            return QuantizedWeight(q, scale, None, x.shape, 8,
+                                   symmetric=True, per_channel=True)
         # pad the flat vector to a multiple of group_size: every tensor gets
         # the CONFIGURED group granularity (prime/awkward sizes must not
         # collapse to one whole-tensor scale)
@@ -127,6 +146,8 @@ class WeightQuantization:
 
     @staticmethod
     def dequantize_leaf(qw, dtype=jnp.bfloat16):
+        if getattr(qw, "per_channel", False):
+            return qw.q.astype(dtype) * qw.scale.astype(dtype)
         q = unpack_int4(qw.q) if qw.bits == 4 else qw.q
         groups = qw.scale.shape[0]
         flat = dequantize(q.reshape(groups, -1), qw.scale, qw.zero,

@@ -1,4 +1,9 @@
-"""Pallas decode-attention kernel vs the XLA cached_attention reference."""
+"""Pallas decode-attention kernel vs the XLA cached_attention reference.
+
+Caches are S-major with flattened heads — [B, S_max, KVH*D] (layer-stacked:
+[L, B, S_max, KVH*D]) — the decode kernel's full-lane-width DMA layout.
+Helpers below build them from head-major [B, KVH, S, D] test data.
+"""
 
 import os
 
@@ -10,6 +15,13 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.models.transformer import cached_attention
 from deepspeed_tpu.ops.transformer.decode_attention import decode_attention
+
+
+def to_smajor(head_major):
+    """[.., KVH, S, D] → [.., S, KVH*D]"""
+    *lead, KVH, S, D = head_major.shape
+    x = jnp.moveaxis(head_major, -3, -2)                 # [.., S, KVH, D]
+    return x.reshape(*lead, S, KVH * D)
 
 
 def xla_cached_attention(*args, **kwargs):
@@ -29,11 +41,11 @@ def test_decode_matches_cached_attention(kvh, length):
     B, H, D, S_max = 2, 8, 16, 64
     rng = np.random.default_rng(length * 10 + kvh)
     q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
-    # caches are head-major [B, KVH, S_max, D]
     k = jnp.zeros((B, kvh, S_max, D), jnp.float32)
     v = jnp.zeros((B, kvh, S_max, D), jnp.float32)
     k = k.at[:, :, :length].set(rng.standard_normal((B, kvh, length, D)))
     v = v.at[:, :, :length].set(rng.standard_normal((B, kvh, length, D)))
+    k, v = to_smajor(k), to_smajor(v)
     pos = jnp.full((B, 1), length - 1, jnp.int32)
     want = np.asarray(xla_cached_attention(q, k, v, pos))          # [B,1,H,D]
     got = np.asarray(decode_attention(
@@ -48,12 +60,13 @@ def test_decode_per_batch_lengths():
     q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((B, H, S_max, D)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((B, H, S_max, D)), jnp.float32)
+    ks, vs = to_smajor(k), to_smajor(v)
     lengths = jnp.asarray([1, 16, 32], jnp.int32)
-    got = np.asarray(decode_attention(q, k, v, lengths))
+    got = np.asarray(decode_attention(q, ks, vs, lengths))
     for b, L in enumerate([1, 16, 32]):
         pos = jnp.asarray([[L - 1]], jnp.int32)
         want = np.asarray(xla_cached_attention(
-            q[b:b + 1, None], k[b:b + 1], v[b:b + 1], pos))[0, 0]
+            q[b:b + 1, None], ks[b:b + 1], vs[b:b + 1], pos))[0, 0]
         np.testing.assert_allclose(got[b], want, rtol=2e-5, atol=2e-5)
 
 
@@ -64,12 +77,13 @@ def test_decode_blocked_cache():
     q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((B, H, S_max, D)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((B, H, S_max, D)), jnp.float32)
+    ks, vs = to_smajor(k), to_smajor(v)
     L = 1500
-    got = np.asarray(decode_attention(q, k, v,
+    got = np.asarray(decode_attention(q, ks, vs,
                                       jnp.asarray([L], jnp.int32),
                                       block_k=512))
     want = np.asarray(xla_cached_attention(
-        q[:, None], k, v, jnp.asarray([[L - 1]], jnp.int32)))[:, 0]
+        q[:, None], ks, vs, jnp.asarray([[L - 1]], jnp.int32)))[:, 0]
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
@@ -77,39 +91,35 @@ def test_decode_stacked_layer_indexing():
     """The layer-stacked cache path (kernel DMAs the layer's blocks via a
     scalar-prefetch index map — no per-layer slice materializes) is
     bit-identical to slicing the layer out first."""
-    import jax
-    import jax.numpy as jnp
-    from deepspeed_tpu.ops.transformer.decode_attention import decode_attention
-
     rng = np.random.default_rng(0)
     L, B, KVH, S, D, H = 3, 2, 4, 64, 32, 8
     k = jnp.asarray(rng.standard_normal((L, B, KVH, S, D)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((L, B, KVH, S, D)), jnp.float32)
     q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    ks, vs = to_smajor(k), to_smajor(v)
     lengths = jnp.asarray([30, 50], jnp.int32)
     for li in range(L):
-        stacked = decode_attention(q, k, v, lengths, layer=jnp.asarray(li))
-        sliced = decode_attention(q, k[li], v[li], lengths)
+        stacked = decode_attention(q, ks, vs, lengths, layer=jnp.asarray(li))
+        sliced = decode_attention(q, ks[li], vs[li], lengths)
         np.testing.assert_array_equal(np.asarray(stacked), np.asarray(sliced))
     # stacked caches demand a layer index
     with pytest.raises(ValueError):
-        decode_attention(q, k, v, lengths)
+        decode_attention(q, ks, vs, lengths)
 
 
 def test_decode_short_lengths_exact():
     """Dead-region DMA pinning (indices past `lengths` pin to the last live
     block so Mosaic skips their copies) must not change results, including
     degenerate lengths and block-boundary lengths."""
-    from deepspeed_tpu.ops.transformer.decode_attention import decode_attention
-
     rng = np.random.default_rng(0)
     B, KVH, S, D, H = 4, 4, 256, 32, 4
     k = jnp.asarray(rng.standard_normal((B, KVH, S, D)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((B, KVH, S, D)), jnp.float32)
     q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    ks, vs = to_smajor(k), to_smajor(v)
     for lens in ([1, 5, 64, 65], [256, 128, 127, 2]):
         lengths = jnp.asarray(lens, jnp.int32)
-        got = np.asarray(decode_attention(q, k, v, lengths, block_k=64))
+        got = np.asarray(decode_attention(q, ks, vs, lengths, block_k=64))
         for b in range(B):
             for h in range(KVH):
                 s = (np.asarray(q[b, h]) @ np.asarray(k[b, h]).T) / np.sqrt(D)

@@ -195,3 +195,49 @@ def test_untrusted_pickle_checkpoint_gated(model_and_params, tmp_path,
     monkeypatch.setenv("DSTPU_ALLOW_PICKLE_CHECKPOINTS", "1")
     eng.load_checkpoint(str(legacy))
     assert np.asarray(eng.forward(ids)).shape[0] == ids.shape[0]
+
+
+def test_per_channel_int8_inference():
+    """Per-output-channel symmetric INT8 (the decode-path mode: dequant is a
+    bare convert*scale that XLA fuses into the consuming matmul — no bf16
+    weight copy per decode step).  Logits must stay close to fp and greedy
+    decoding must agree with the groupwise mode's quality bar."""
+    from deepspeed_tpu.models.transformer import Transformer, TransformerConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.runtime.weight_quantizer import QuantizedWeight
+
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_heads=4, max_seq_len=32, dtype="float32",
+                            use_flash_attention=False, remat=False)
+    model = Transformer(cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (1, 12)),
+                      jnp.int32)
+    params = model.init(jax.random.key(0), {"input_ids": ids})
+    want = np.asarray(InferenceEngine(
+        model, DeepSpeedInferenceConfig(dtype="float32"),
+        params=params).forward(ids))
+
+    qcfg = DeepSpeedInferenceConfig(
+        dtype="float32", quant={"enabled": True, "bits": 8,
+                                "per_channel": True})
+    eng = InferenceEngine(model, qcfg, params=params)
+    q_leaves = [l for l in jax.tree.leaves(
+        eng.params, is_leaf=lambda x: isinstance(x, QuantizedWeight))
+        if isinstance(l, QuantizedWeight)]
+    assert q_leaves and all(l.q.dtype == jnp.int8 and l.per_channel
+                            for l in q_leaves)
+    # scales are one-per-output-channel: leading (contraction) axis is 1
+    assert all(l.scale.shape[0] == 1 and l.scale.shape[1:] == l.q.shape[1:]
+               for l in q_leaves)
+    got = np.asarray(eng.forward(ids))
+    assert np.mean(np.abs(got - want)) / (np.mean(np.abs(want)) + 1e-9) < 0.1
+    agree = np.mean(np.argmax(got, -1) == np.argmax(want, -1))
+    assert agree >= 0.7, agree
+    out = eng.generate(ids, max_new_tokens=4)
+    assert np.asarray(out).shape == (1, 16)
+
+    # per-channel int4 is rejected (fusable dequant needs bare int8)
+    with pytest.raises(ValueError, match="per_channel"):
+        from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
+        WeightQuantization(bits=4, per_channel=True)

@@ -18,15 +18,21 @@ whole schedule becomes ONE differentiable program:
   stash).  ``jax.checkpoint`` on the stage body gives the same memory
   behavior as its activation-checkpointed stages.
 
-Schedule honesty: this is a **fill-drain (GPipe) schedule** — all M
-microbatches flow forward, then backward.  Its bubble fraction,
-``(P-1)/(M+P-1)``, matches 1F1B, but its activation stash grows with M
-where the reference's ``TrainSchedule`` (1F1B, ``schedule.py:189``) bounds
-in-flight microbatches to ~P.  The 1F1B-class memory bound is provided by
-the engine's chunked accumulation (``pipeline.max_in_flight_microbatches``):
-chunks of C microbatches are differentiated one at a time, so at most C
-stage inputs are ever stashed, at the cost of a per-chunk bubble
-``(P-1)/(C+P-1)``.
+Schedule menu (``pipeline.schedule`` + ``max_in_flight_microbatches``):
+
+* ``spmd_pipeline`` (fill_drain, default) — all M microbatches flow
+  forward, then backward via autodiff.  Bubble ``(P-1)/(M+P-1)`` (the
+  1F1B number — throughput-optimal), but the activation stash grows with
+  M where the reference's ``TrainSchedule`` (1F1B, ``schedule.py:189``)
+  bounds in-flight microbatches to ~P.
+* ``spmd_pipeline_1f1b`` (schedule="1f1b") — hand-rolled interleaved
+  one-forward-one-backward ticks with an O(P) input ring and in-region
+  boundary layers; bubble ``2(P-1)/(M+2(P-1))`` (see
+  ``one_f_one_b_ticks`` for why SPMD lockstep pays P-1 extra ticks vs the
+  reference's asynchronous schedule).  The memory-bounded mode of choice.
+* chunked accumulation (``max_in_flight_microbatches=C``) — fill-drain
+  over chunks of C; O(C) stash at a per-chunk bubble ``(P-1)/(C+P-1)``.
+  Kept for when C must be tuned independently of P.
 
 Activations may be arbitrary pytrees (e.g. ``(hidden, aux_loss)`` for MoE
 trunks); every per-tick primitive is tree-mapped.
@@ -122,6 +128,216 @@ def spmd_pipeline(stage_fn, stacked_params, x0, num_micro, mesh,
 
 def pipeline_bubble_fraction(num_micro, num_stages):
     return (num_stages - 1) / (num_micro + num_stages - 1)
+
+
+def one_f_one_b_ticks(num_micro, num_stages):
+    """Tick count of the interleaved 1F1B schedule: M + 2(P-1).
+
+    Each tick performs one forward AND one (rematerialized) backward unit
+    per stage, so the schedule's bubble fraction is
+    ``2(P-1) / (M + 2(P-1))``.  Relative to the reference's asynchronous
+    1F1B (``runtime/pipe/schedule.py:189``, bubble (P-1)/(M+P-1)): an SPMD
+    program executes every stage's tick in lockstep, so the backward
+    wavefront's extra P-1 ticks of latency cannot hide inside other stages'
+    forward slots — the lockstep schedule pays them at the end.  It keeps
+    1F1B's O(P) activation stash and beats the chunked fill-drain
+    alternative at the same memory bound (M/C chunks × (C+P-1) fwd+bwd
+    ticks; e.g. P=4, M=16, C=4: 28 chunked ticks vs 22 here), while
+    unbounded fill-drain (O(M) stash) remains the throughput-optimal mode
+    at M+P-1 equivalent ticks."""
+    return num_micro + 2 * (num_stages - 1)
+
+
+
+def spmd_pipeline_1f1b(stage_fn, stacked_params, first_fn, first_params,
+                       last_fn, last_params, inputs, labels, num_micro, mesh,
+                       cotangent_seed=1.0, pp_axis=PP_AXIS):
+    """Interleaved 1F1B pipeline with hand-rolled per-tick backward.
+
+    TPU-native rendering of the reference ``TrainSchedule``
+    (``runtime/pipe/schedule.py:189``): one ``lax.scan`` over
+    ``one_f_one_b_ticks(M, P)`` ticks inside ``shard_map`` over ``pp``.
+    Like the reference's stage placement, the boundary layers live INSIDE
+    the schedule — ``first_fn`` (embedding/pre chain) runs on stage 0 and
+    ``last_fn`` (post chain + per-microbatch loss) on the last stage — so
+    the only M-sized buffers in the program are the raw ``inputs``/
+    ``labels`` (token ids), exactly as in the reference.  Per tick,
+    stage *s*:
+
+    * forward of microbatch ``m_f = t - s`` (stage 0 embeds
+      ``inputs[m_f]`` via ``first_fn``; other stages receive via the
+      forward ``ppermute``), stashing its input activation in a ring of
+      depth ``2P-1`` — the O(P) bound that replaces autodiff's O(M)
+      residual stash (stage 0 also rings the raw input for its pre-chain
+      backward);
+    * on the LAST stage, ``last_fn`` runs for ``m_l = t-(P-1)`` and its
+      vjp seeds the backward wavefront THE SAME TICK (``cotangent_seed``
+      is the loss-scale/mean factor);
+    * backward of microbatch ``m_b = t - 2(P-1) + s``: the stage input is
+      re-read from the ring and the stage re-linearized (``jax.vjp``) —
+      rematerialized backward, exactly like the fill-drain mode's
+      ``jax.checkpoint``-ed stages; the input-cotangent rides the reverse
+      ``ppermute`` to stage s-1, where stage 0 instead backpropagates it
+      through ``first_fn``.
+
+    Returns ``(loss_sum, body_grads_stacked, first_grads, last_grads)``:
+    ``loss_sum`` is the RAW sum of per-microbatch losses (unscaled); the
+    gradient sums are scaled by ``cotangent_seed`` (seed with ``scale/M``
+    to get gradients of ``mean(loss)*scale``).
+    """
+    n_stages = mesh.shape[pp_axis]
+    M = num_micro
+    R = 2 * n_stages - 1
+    T = one_f_one_b_ticks(M, n_stages)
+
+    def region(params, first_p, last_p, inputs, labels, seed):
+        sid = lax.axis_index(pp_axis)
+        last_sid = n_stages - 1
+        params_local = jax.tree.map(lambda a: jnp.squeeze(a, 0), params)
+
+        in0 = jax.tree.map(lambda l: l[0], inputs)
+        act0 = jax.eval_shape(lambda p, i: first_fn(p, i), first_p, in0)
+        act0 = jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), act0)
+        ring_act0 = jax.tree.map(
+            lambda l: jnp.zeros((R, *l.shape), l.dtype), act0)
+        ring_in0 = jax.tree.map(
+            lambda l: jnp.zeros((R, *l.shape[1:]), l.dtype), inputs)
+        zeros_f32 = lambda t: jax.tree.map(
+            lambda p: jnp.zeros(jnp.shape(p), jnp.float32), t)
+        gbody0, gfirst0, glast0 = (zeros_f32(params_local),
+                                   zeros_f32(first_p), zeros_f32(last_p))
+
+        fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+        bwd_perm = [(i + 1, i) for i in range(n_stages - 1)]
+
+        def at(tree, idx):
+            return jax.tree.map(
+                lambda l: lax.dynamic_index_in_dim(l, idx, 0, keepdims=False),
+                tree)
+
+        def put(tree, val, idx):
+            return jax.tree.map(
+                lambda l, v: lax.dynamic_update_index_in_dim(
+                    l, v.astype(l.dtype), idx, 0), tree, val)
+
+        def mask(tree, cond):
+            return jax.tree.map(
+                lambda l: jnp.where(cond, l, jnp.zeros_like(l)), tree)
+
+        def tick(carry, t):
+            (y_state, dx_state, ring_act, ring_in, gbody, gfirst, glast,
+             loss_acc) = carry
+            # ---- forward unit ----
+            recv = jax.tree.map(
+                lambda l: lax.ppermute(l, pp_axis, fwd_perm),
+                y_state) if n_stages > 1 else y_state
+            # NOTE control-flow discipline: every lax.cond predicate below
+            # depends on the tick counter t ONLY (globally uniform), never
+            # on the stage id — a sid-dependent branch containing the
+            # tp-sharded head/embedding diverged the pp groups' collective
+            # sequences and deadlocked the mesh.  sid-dependence is
+            # expressed with jnp.where masks on uniformly-executed compute.
+            m_f = t - sid
+            f_active = jnp.logical_and(m_f >= 0, m_f < M)
+            in_m = at(inputs, jnp.clip(m_f, 0, M - 1))
+            x_first = lax.cond(t < M,
+                               lambda: first_fn(first_p, in_m),
+                               lambda: jax.tree.map(jnp.zeros_like, recv))
+            x_in = jax.tree.map(
+                lambda a, b: jnp.where(sid == 0, a, b), x_first, recv)
+            y = mask(stage_fn(params_local, x_in), f_active)
+            ring_act = put(ring_act, x_in, t % R)
+            ring_in = put(ring_in, in_m, t % R)
+            # ---- loss + backward seed on the last stage ----
+            m_l = t - last_sid
+            l_active = jnp.logical_and(m_l >= 0, m_l < M)
+            l_window = jnp.logical_and(t >= 0, t < M + last_sid + 1)
+
+            def seed_branch():
+                lab = at(labels, jnp.clip(m_l, 0, M - 1))
+                loss_m, lvjp = jax.vjp(
+                    lambda lp, yy: last_fn(lp, yy, lab), last_p, y)
+                dlast, dy = lvjp(seed.astype(loss_m.dtype))
+                on_last = jnp.logical_and(sid == last_sid, l_active)
+                return jnp.where(on_last, loss_m.astype(jnp.float32), 0.0), \
+                    mask(jax.tree.map(lambda g: g.astype(jnp.float32),
+                                      dlast), on_last), \
+                    mask(dy, on_last)
+
+            def zero_branch():
+                return jnp.zeros((), jnp.float32), zeros_f32(last_p), \
+                    jax.tree.map(jnp.zeros_like, y)
+
+            loss_m, dlast_m, dy_seed = lax.cond(
+                l_window, seed_branch, zero_branch)
+            loss_acc = loss_acc + loss_m
+            glast = jax.tree.map(jnp.add, glast, dlast_m)
+            # ---- backward unit ----
+            brecv = jax.tree.map(
+                lambda l: lax.ppermute(l, pp_axis, bwd_perm),
+                dx_state) if n_stages > 1 else dx_state
+            m_b = t - 2 * (n_stages - 1) + sid
+            b_active = jnp.logical_and(m_b >= 0, m_b < M)
+            dy_in = jax.tree.map(
+                lambda a, b: jnp.where(sid == last_sid, a, b),
+                dy_seed, brecv)
+            # the stashed input of this stage's forward of m_b (tick
+            # t_f = t - 2(P-1) + 2s); re-linearize = rematerialized backward
+            t_f = t - 2 * (n_stages - 1) + 2 * sid
+            slot = jnp.clip(t_f, 0, T - 1) % R
+            x_b = at(ring_act, slot)
+            _, svjp = jax.vjp(stage_fn, params_local, x_b)
+            dp, dx = svjp(jax.tree.map(
+                lambda l, yl: l.astype(yl.dtype), dy_in, y))
+            gbody = jax.tree.map(
+                lambda g, d: g + jnp.where(b_active,
+                                           d.astype(jnp.float32), 0.0),
+                gbody, dp)
+            dx = mask(dx, b_active)
+
+            # stage 0 backpropagates its input-cotangent through first_fn
+            # (uniform-predicate window; sid-dependence via masks, as above)
+            b0_window = jnp.logical_and(t >= 2 * (n_stages - 1),
+                                        t < 2 * (n_stages - 1) + M)
+
+            def first_b_branch():
+                in_b = at(ring_in, slot)
+                _, fvjp = jax.vjp(lambda fp: first_fn(fp, in_b), first_p)
+                (dfp,) = fvjp(jax.tree.map(
+                    lambda l, xl: l.astype(xl.dtype), dx, x_b))
+                return mask(jax.tree.map(
+                    lambda g: g.astype(jnp.float32), dfp),
+                    jnp.logical_and(sid == 0, b_active))
+
+            dfirst_m = lax.cond(b0_window, first_b_branch,
+                                lambda: zeros_f32(first_p))
+            gfirst = jax.tree.map(jnp.add, gfirst, dfirst_m)
+            return (y, dx, ring_act, ring_in, gbody, gfirst, glast,
+                    loss_acc), None
+
+        carry0 = (act0, jax.tree.map(jnp.zeros_like, act0), ring_act0,
+                  ring_in0, gbody0, gfirst0, glast0,
+                  jnp.zeros((), jnp.float32))
+        (_, _, _, _, gbody, gfirst, glast, loss_acc), _ = lax.scan(
+            tick, carry0, jnp.arange(T))
+        # loss/last-grads live on the last stage, first-grads on stage 0;
+        # psum broadcasts each to every pp shard
+        if n_stages > 1:
+            loss_acc = lax.psum(loss_acc, pp_axis)
+            glast = lax.psum(glast, pp_axis)
+            gfirst = lax.psum(gfirst, pp_axis)
+        gbody = jax.tree.map(lambda g: g[None], gbody)
+        return loss_acc, gbody, gfirst, glast
+
+    in_specs = (jax.tree.map(lambda _: P(pp_axis), stacked_params),
+                P(), P(), P(), P(), P())
+    out_specs = (P(), jax.tree.map(lambda _: P(pp_axis), stacked_params),
+                 P(), P())
+    return jax.shard_map(
+        region, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        axis_names=frozenset({pp_axis}), check_vma=False,
+    )(stacked_params, first_params, last_params, inputs, labels,
+      jnp.asarray(cotangent_seed, jnp.float32))
 
 
 def stack_stage_params(per_layer_params, num_stages):

@@ -191,6 +191,11 @@ class PipelineConfig(DeepSpeedConfigModel):
     # this many microbatches at a time, so at most this many stage inputs
     # are ever stashed.  0 = unbounded fill-drain (lowest bubble).
     max_in_flight_microbatches: int = 0
+    # "fill_drain" (default; GPipe-order, bubble (P-1)/(M+P-1), O(M) stash)
+    # or "1f1b": interleaved one-forward-one-backward ticks with an O(P)
+    # input ring (reference TrainSchedule's memory bound) at bubble
+    # 2(P-1)/(M+2(P-1)) — see parallel/pipeline.py for the SPMD tick math.
+    schedule: str = "fill_drain"
 
 
 class SequenceParallelConfig(DeepSpeedConfigModel):

@@ -61,33 +61,57 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
             self._lazy_init((dummy,), {})
         if self._cast_fn is None:
             cast = self.compute_dtype
-            # inference placement: keep TP sharding, drop ZeRO scattering
-            # (replicate over dp) so each decode step is gather-free.
-            from deepspeed_tpu.runtime.zero.partition import (
-                is_expert_stacked, path_to_str, tp_spec_for)
-
-            def spec_of(path, leaf):
-                ps = path_to_str(path)
-                return NamedSharding(
-                    self.mesh,
-                    tp_spec_for(ps, leaf.shape, self.mesh,
-                                expert_stacked=is_expert_stacked(
-                                    ps, len(leaf.shape))))
-            abstract = jax.tree.map(
-                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype),
-                self._params)
-            shardings = jax.tree_util.tree_map_with_path(spec_of, abstract)
             self._cast_fn = jax.jit(
                 lambda t: jax.tree.map(
                     lambda p: p.astype(cast)
                     if jnp.issubdtype(p.dtype, jnp.floating) else p, t),
-                out_shardings=shardings)
+                out_shardings=self._infer_shardings())
         params = self._params
         if self._lora_spec is not None and not self._lora_fused:
             params = _fuse_lora(params, self._lora_spec)
-        self._infer_params = self._cast_fn(params)
+        if params is self._params and self._view_is_identity():
+            # memory-lean masters are already compute-dtype and, on a
+            # mesh without live ZeRO scattering, already placed as the
+            # inference program wants them: the "view" IS the master
+            # buffers — zero-copy weight sharing (what the reference's
+            # shared-container design approximates with pointer swaps)
+            self._infer_params = params
+        else:
+            self._infer_params = self._cast_fn(params)
         self._infer_params_step = self.global_steps
         return self._infer_params
+
+    def _infer_shardings(self):
+        """Inference placement: keep TP sharding, drop ZeRO scattering
+        (replicate over dp) so each decode step is gather-free."""
+        from deepspeed_tpu.runtime.zero.partition import (
+            is_expert_stacked, path_to_str, tp_spec_for)
+
+        def spec_of(path, leaf):
+            ps = path_to_str(path)
+            return NamedSharding(
+                self.mesh,
+                tp_spec_for(ps, leaf.shape, self.mesh,
+                            expert_stacked=is_expert_stacked(
+                                ps, len(leaf.shape))))
+        abstract = jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), self._params)
+        return jax.tree_util.tree_map_with_path(spec_of, abstract)
+
+    def _view_is_identity(self):
+        """True when cast+reshard would be a no-op copy: every float leaf is
+        already compute-dtype and every leaf is already placed exactly as
+        the inference sharding plan asks."""
+        cast = self.compute_dtype
+        shardings = jax.tree.leaves(self._infer_shardings())
+        leaves = jax.tree.leaves(self._params)
+        for leaf, want in zip(leaves, shardings):
+            if jnp.issubdtype(leaf.dtype, jnp.floating) and leaf.dtype != cast:
+                return False
+            sh = getattr(leaf, "sharding", None)
+            if sh is None or not sh.is_equivalent_to(want, leaf.ndim):
+                return False
+        return True
 
     # ------------------------------------------------------------------ #
     # LoRA (reference hybrid_engine fuse_lora_weight/unfuse_lora_weight)

@@ -71,6 +71,15 @@ class PipelineEngine(DeepSpeedEngine):
                 f"pipeline.max_in_flight_microbatches={C} must divide "
                 f"micro_batches={self.micro_batches}")
         self.max_in_flight = C
+        sched = self._config.pipeline.schedule
+        if sched not in ("fill_drain", "1f1b"):
+            raise ValueError(f"pipeline.schedule must be 'fill_drain' or "
+                             f"'1f1b', got {sched!r}")
+        if sched == "1f1b" and C:
+            raise ValueError(
+                "pipeline.schedule='1f1b' already bounds the stash to O(P); "
+                "it is mutually exclusive with max_in_flight_microbatches")
+        self.pipe_schedule = sched
 
     # the reference forbids forward/backward/step on the pipeline engine —
     # train_batch is the unit of work (pipe/engine.py:1107-1118)
@@ -307,6 +316,88 @@ class PipelineEngine(DeepSpeedEngine):
         losses = jax.vmap(loss_fn)(out, labels)
         return jnp.mean(losses.astype(jnp.float32))
 
+    def _pipe_loss_and_grads_1f1b(self, params, batch, scale, train=True):
+        """Interleaved 1F1B step: hand-rolled per-tick vjp inside the
+        ``spmd_pipeline_1f1b`` region (reference ``TrainSchedule``,
+        ``schedule.py:189``).  Boundary layers run INSIDE the region like
+        the reference's stage placement — the pre chain (embeddings) on
+        stage 0, the post chain + per-microbatch loss on the last stage —
+        so each microbatch's backward starts the tick its forward finishes
+        and the only M-sized buffers are the raw token ids/labels.
+        Returns ``(scaled mean loss, grads)`` with the same semantics as
+        differentiating ``mean(loss) * scale``."""
+        from deepspeed_tpu.parallel.pipeline import spmd_pipeline_1f1b
+        inputs, labels = _split_batch(batch)
+        M = self.micro_batches
+        cast = lambda t: jax.tree.map(
+            lambda p: p.astype(self.compute_dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, t)
+
+        def run_chain(entries, ps, x, seen):
+            for e in entries:
+                if e["reuse_of"] is not None:
+                    p = seen[e["reuse_of"]]
+                elif e["params"] is not None:
+                    p = next(ps)
+                    seen[e["layer_idx"]] = p
+                else:
+                    p = None
+                x = e["apply"](p, x, train=train)
+            return x
+
+        pre_cast, pre_vjp = jax.vjp(cast, params["pre"])
+        body_cast, body_vjp = jax.vjp(cast, params["body"])
+        post_cast, post_vjp = jax.vjp(cast, params["post"])
+
+        def first_fn(first_p, in_m):
+            return run_chain(self._pre, iter(first_p), in_m, {})
+
+        layer_apply = self._body_apply
+
+        def stage_fn(stage_params, xm):
+            def one(h, p):
+                return layer_apply(p, h, train=train), None
+            out, _ = jax.lax.scan(one, xm, stage_params)
+            return out
+
+        loss_fn = self.pipe_module.loss_fn or _default_loss
+        # post layers may reuse (tied) pre-layer params: thread ONLY the
+        # tied subtrees through the last-stage vjp (an untied model must
+        # not pay a second embedding-grad accumulator + pp psum for a
+        # gradient that is identically zero)
+        pre_param_idx = [e["layer_idx"] for e in self._pre
+                         if e["params"] is not None]
+        tied_idx = sorted({e["reuse_of"] for e in self._post
+                           if e["reuse_of"] is not None})
+        tied_pos = [pre_param_idx.index(i) for i in tied_idx]
+        tied_cast = [pre_cast[p] for p in tied_pos]
+
+        def last_fn(last_p, y, label):
+            post_params, tied_params = last_p
+            seen = dict(zip(tied_idx, tied_params))
+            out = run_chain(self._post, iter(post_params), y, seen)
+            # mean-reduce: fill-drain computes jnp.mean over vmapped losses,
+            # so a per-example loss_fn keeps working under 1f1b too
+            return jnp.mean(loss_fn(out, label).astype(jnp.float32))
+
+        loss_sum, gbody_c, gfirst_c, glast_c = spmd_pipeline_1f1b(
+            stage_fn, body_cast, first_fn, pre_cast, last_fn,
+            (post_cast, tied_cast), inputs, labels, M, self.mesh,
+            cotangent_seed=scale / M)
+        gpost_c, gtied_c = glast_c
+        # pre grads: ring-backward contribution + tied-use contribution
+        gpre_c = list(gfirst_c)
+        for pos, g in zip(tied_pos, gtied_c):
+            gpre_c[pos] = jax.tree.map(jnp.add, gpre_c[pos], g)
+        match = lambda g, p: jax.tree.map(
+            lambda gl, pl: gl.astype(pl.dtype), g, p)
+        (gbody,) = body_vjp(match(gbody_c, body_cast))
+        (gpost,) = post_vjp(match(gpost_c, post_cast))
+        (gpre,) = pre_vjp(match(gpre_c, pre_cast))
+        grads = {"pre": gpre, "body": gbody, "post": gpost}
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        return loss_sum * scale / M, grads
+
     def _get_fused_step(self):
         key = "fused_pipe_step"
         if key not in self._compiled:
@@ -321,7 +412,10 @@ class PipelineEngine(DeepSpeedEngine):
                     return self._pipe_loss(p, b, rng, num_micro=n) \
                         * scaler_state.scale
 
-                if C and C < M:
+                if self.pipe_schedule == "1f1b":
+                    loss, grads = self._pipe_loss_and_grads_1f1b(
+                        params, batch, scaler_state.scale)
+                elif C and C < M:
                     # 1F1B-class memory bound: differentiate C microbatches
                     # at a time so at most C stage inputs are stashed; the
                     # scan accumulates grads chunk by chunk (reference

@@ -377,24 +377,90 @@ def scatter(tensor, scatter_list=None, src=0, group=None, log_name=None):
 
 
 def isend(tensor, dst, group=None, tag=0):
-    """Point-to-point verbs (reference ``comm.py:420`` isend/irecv,
-    ``:428`` send/recv) are NOT supported as standalone eager ops on TPU —
-    this always raises with guidance.  Rank-addressed p2p has no XLA analog
-    outside a compiled collective: the one-call SPMD equivalent of a
-    send/recv PAIR is :func:`p2p` (or :func:`ppermute` /
-    :func:`send_recv_next` / :func:`send_recv_prev`) inside ``shard_map``
-    — both halves of each exchange are one collective-permute riding ICI,
-    which is how the pipeline engine moves activations."""
+    """Async point-to-point verbs (reference ``comm.py:420`` isend/irecv)
+    are NOT supported as standalone eager ops on TPU — this always raises
+    with guidance.  Rank-addressed p2p has no XLA analog outside a compiled
+    collective: the one-call SPMD equivalent of a send/recv PAIR is
+    :func:`p2p` (or :func:`ppermute` / :func:`send_recv_next` /
+    :func:`send_recv_prev`) inside ``shard_map`` — both halves of each
+    exchange are one collective-permute riding ICI, which is how the
+    pipeline engine moves activations.  Synchronous reference-shaped
+    ``send``+``recv`` pairs with static endpoints ARE supported — see
+    :func:`send`."""
     raise NotImplementedError(
-        "isend/irecv/send/recv have no eager analog on TPU: call "
+        "isend/irecv have no eager analog on TPU: call "
         "dist.p2p(tensor, src, dst, group) — the send/recv pair as ONE "
         "collective — or ppermute/send_recv_next inside shard_map "
-        "(pipeline p2p rides ICI)")
+        "(pipeline p2p rides ICI); statically-paired send()+recv() also "
+        "works inside shard_map")
 
 
 irecv = isend
-send = isend
-recv = isend
+
+# one outstanding send awaiting its recv (see send/recv below)
+_pending_send = []
+
+
+def send(tensor, dst, group=None, tag=0):
+    """Compatibility shim for reference-shaped ``send``/``recv`` pairs
+    (reference ``comm.py:428``).  Under SPMD every rank executes BOTH
+    calls, so a pair with STATIC endpoints
+
+    .. code-block:: python
+
+        dist.send(x, dst=5, group=("edp",))
+        out = dist.recv(buf, src=2, group=("edp",))
+
+    is statically resolvable to one mesh-axis permute: the matched pair
+    lowers to ONE :func:`p2p` collective (rank ``dst``'s ``recv`` returns
+    rank ``src``'s ``x``; every other rank keeps its ``buf``).  Endpoints
+    must be Python ints and the pair must match on group and tag —
+    genuinely dynamic patterns (traced endpoints, rank-divergent control
+    flow, unmatched halves) still raise with guidance, because no single
+    SPMD program can express them."""
+    if not any(_is_traced(l) for l in jax.tree.leaves(tensor)):
+        raise NotImplementedError(
+            "send/recv are compiled collectives here: call the pair inside "
+            "shard_map/jit (or use dist.p2p directly)")
+    if not isinstance(dst, int):
+        raise NotImplementedError(
+            "send(dst=...) must be a static Python int: a traced endpoint "
+            "is rank-dynamic and has no single-program SPMD lowering — "
+            "use dist.p2p/ppermute to express the whole exchange")
+    if _pending_send:
+        # an aborted trace (error between send and recv) may leave a stale
+        # entry holding a dead tracer; raising here would poison every
+        # later pair, so drop it with a warning instead
+        logger.warning("send(): dropping an unmatched previous send "
+                       "(aborted trace, or a send that was never recv'd)")
+        _pending_send.clear()
+    _pending_send.append((tensor, int(dst), _axes(group), tag))
+    return tensor
+
+
+def recv(tensor, src, group=None, tag=0):
+    """The receive half of a statically-paired send/recv — see
+    :func:`send`.  ``tensor`` is the receive buffer: returned unchanged on
+    every rank except the send's ``dst``, which gets rank ``src``'s sent
+    value."""
+    if not _pending_send:
+        raise NotImplementedError(
+            "recv() without a preceding send(): under SPMD both halves of "
+            "the exchange execute on every rank — call send(x, dst) then "
+            "recv(buf, src) in the same traced function, or use "
+            "dist.p2p(tensor, src, dst, group) directly")
+    sent, dst, saxes, stag = _pending_send.pop()
+    if not isinstance(src, int):
+        raise NotImplementedError(
+            "recv(src=...) must be a static Python int (see send())")
+    if _axes(group) != saxes or tag != stag:
+        raise ValueError(
+            f"recv(group={_axes(group)}, tag={tag}) does not match the "
+            f"pending send(group={saxes}, tag={stag})")
+    moved = p2p(sent, src, dst, group)
+    idx = lax.axis_index(saxes[0])
+    return jax.tree.map(
+        lambda m, buf: jnp.where(idx == dst, m, buf), moved, tensor)
 
 
 def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):

@@ -48,7 +48,13 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
     # ------------------------------------------------------------------ #
     def _inference_view(self):
         """bf16 (compute-dtype), TP-sharded / ZeRO-gathered view of the
-        current master params; rebuilt only after an optimizer step."""
+        current master params; rebuilt only after an optimizer step.
+
+        NOTE lifetime: when the masters are already compute-dtype and
+        inference-placed, the view ALIASES the live master buffers
+        (zero-copy) — the next optimizer step donates those buffers, so a
+        view held across ``train_batch``/``step`` is dead afterwards.
+        Always re-fetch per rollout (``generate`` does)."""
         if self._infer_params is not None and \
                 self._infer_params_step == self.global_steps:
             return self._infer_params
@@ -101,17 +107,25 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
     def _view_is_identity(self):
         """True when cast+reshard would be a no-op copy: every float leaf is
         already compute-dtype and every leaf is already placed exactly as
-        the inference sharding plan asks."""
+        the inference sharding plan asks.  Computed once — the donating
+        update preserves dtypes and out-shardings, so the verdict cannot
+        change between steps."""
+        if getattr(self, "_view_identity", None) is not None:
+            return self._view_identity
         cast = self.compute_dtype
         shardings = jax.tree.leaves(self._infer_shardings())
         leaves = jax.tree.leaves(self._params)
+        verdict = True
         for leaf, want in zip(leaves, shardings):
             if jnp.issubdtype(leaf.dtype, jnp.floating) and leaf.dtype != cast:
-                return False
+                verdict = False
+                break
             sh = getattr(leaf, "sharding", None)
             if sh is None or not sh.is_equivalent_to(want, leaf.ndim):
-                return False
-        return True
+                verdict = False
+                break
+        self._view_identity = verdict
+        return verdict
 
     # ------------------------------------------------------------------ #
     # LoRA (reference hybrid_engine fuse_lora_weight/unfuse_lora_weight)

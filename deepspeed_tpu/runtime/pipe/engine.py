@@ -367,8 +367,10 @@ class PipelineEngine(DeepSpeedEngine):
         # gradient that is identically zero)
         pre_param_idx = [e["layer_idx"] for e in self._pre
                          if e["params"] is not None]
+        # only PRE-sourced ties need threading; a tie between two post
+        # layers resolves naturally inside run_chain's `seen`
         tied_idx = sorted({e["reuse_of"] for e in self._post
-                           if e["reuse_of"] is not None})
+                           if e["reuse_of"] in set(pre_param_idx)})
         tied_pos = [pre_param_idx.index(i) for i in tied_idx]
         tied_cast = [pre_cast[p] for p in tied_pos]
 

@@ -1,0 +1,69 @@
+"""Worker for the real multi-process bootstrap test (launched through
+``launcher/runner.py``; see ``test_multiprocess_bootstrap.py``).
+
+Each OS process brings ``WORKER_LOCAL_DEVICES`` virtual CPU devices; with a
+``DSTPU_COORDINATOR_ADDRESS`` in the environment (injected per-host by the
+launcher), ``deepspeed_tpu.init_distributed`` rendezvouses the processes via
+``jax.distributed.initialize`` into one global mesh — the analog of the
+reference's multi-process test harness (``tests/unit/common.py:89-186``)
+and its RANK/MASTER_ADDR bootstrap (``launcher/launch.py:216``).
+"""
+
+import os
+import sys
+
+n_local = int(os.environ.get("WORKER_LOCAL_DEVICES", "4"))
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + f" --xla_force_host_platform_device_count={n_local}").strip()
+os.environ["DSTPU_ACCELERATOR"] = "cpu"
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.environ["DSTPU_REPO_ROOT"])
+
+import numpy as np
+import jax
+
+# the environment may pin a hardware platform via sitecustomize (which
+# imports jax at interpreter start) — env vars alone are too late, the live
+# config must be updated before any backend/distributed use
+jax.config.update("jax_platforms", "cpu")
+
+import deepspeed_tpu
+
+deepspeed_tpu.init_distributed()
+
+import jax.numpy as jnp  # noqa: E402  (after distributed init)
+from deepspeed_tpu.models.transformer import Transformer, TransformerConfig
+
+rank, world = jax.process_index(), jax.process_count()
+print(f"[worker] process {rank}/{world}, local devices "
+      f"{jax.local_device_count()}, global {jax.device_count()}", flush=True)
+
+cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=4, max_seq_len=32,
+                        use_flash_attention=False, dtype="float32",
+                        scan_layers=False, remat=False)
+engine, *_ = deepspeed_tpu.initialize(
+    model=Transformer(cfg),
+    config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "seed": 0,
+    })
+
+# every process supplies the same global batch (single-controller-per-host:
+# the engine shards it over the global mesh)
+rng = np.random.default_rng(0)
+batch = {"input_ids": rng.integers(
+    0, 64, (1, 2 * engine.topology.dp, 16)).astype(np.int32)}
+losses = []
+for _ in range(2):
+    loss = engine.train_batch(batch=batch)
+    losses.append(float(jax.device_get(loss)))
+print(f"[worker] rank {rank} losses: {losses}", flush=True)
+
+out = os.environ.get("WORKER_OUT")
+if out:
+    with open(f"{out}.rank{rank}", "w") as f:
+        f.write(" ".join(repr(l) for l in losses))

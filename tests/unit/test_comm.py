@@ -152,3 +152,43 @@ def test_p2p_single_pair(topo):
 def test_send_raises_with_p2p_guidance(topo):
     with pytest.raises(NotImplementedError, match="p2p"):
         dist.send(jnp.zeros(4), dst=1)
+
+
+def test_send_recv_static_pair_lowers_to_p2p(topo):
+    """Reference-shaped send/recv with static endpoints: the pair lowers to
+    one collective permute — dst's recv returns src's sent value, everyone
+    else keeps their receive buffer (reference ``comm.py:428``)."""
+    x = jnp.arange(8.0) + 1.0
+
+    def pair(v):
+        dist.send(v, dst=5, group=(EDP_AXIS,))
+        return dist.recv(jnp.full_like(v, -1.0), src=2, group=(EDP_AXIS,))
+
+    out = _run_collective(topo, pair, x, P(EDP_AXIS), P(EDP_AXIS))
+    want = np.full(8, -1.0)
+    want[5] = 3.0                    # src=2 holds x[2] = 3.0
+    np.testing.assert_allclose(np.asarray(out), want)
+
+
+def test_send_recv_mismatch_and_dynamic_raise(topo):
+    # recv with no pending send
+    with pytest.raises(NotImplementedError, match="p2p"):
+        _run_collective(topo,
+                        lambda v: dist.recv(v, src=0, group=(EDP_AXIS,)),
+                        jnp.zeros(8), P(EDP_AXIS), P(EDP_AXIS))
+    # traced (dynamic) endpoint
+    def dyn(v):
+        return dist.send(v, dst=jnp.argmax(v), group=(EDP_AXIS,))
+    with pytest.raises(Exception, match="static"):
+        _run_collective(topo, dyn, jnp.zeros(8), P(EDP_AXIS), P(EDP_AXIS))
+    from deepspeed_tpu.comm.comm import _pending_send
+    _pending_send.clear()
+    # group mismatch between the halves
+    def mismatched(v):
+        dist.send(v, dst=1, group=(EDP_AXIS,))
+        return dist.recv(v, src=0, group=("tp",))
+    with pytest.raises(ValueError, match="does not match"):
+        _run_collective(topo, mismatched, jnp.zeros(8),
+                        P(EDP_AXIS), P(EDP_AXIS))
+    from deepspeed_tpu.comm.comm import _pending_send
+    _pending_send.clear()

@@ -1,0 +1,90 @@
+"""REAL multi-process bootstrap: two OS processes rendezvous through
+``jax.distributed.initialize`` (``comm/backend.py``) into one 8-device mesh,
+launched through ``launcher/runner.py``'s host fan-out — the analog of the
+reference's process-spawning distributed test harness
+(``tests/unit/common.py:89-186``) and per-host env bootstrap
+(``launcher/launch.py:216``).  Unlike ``test_data_launcher.py`` (command
+construction only), these tests execute the full path: launcher → per-host
+env injection → coordinator rendezvous → cross-process ZeRO-2 step."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "mp_worker.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _worker_env(out, local_devices):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("XLA_", "JAX_", "DSTPU_"))}
+    env.update({"DSTPU_REPO_ROOT": REPO, "WORKER_OUT": out,
+                "WORKER_LOCAL_DEVICES": str(local_devices)})
+    return env
+
+
+def _read_losses(path):
+    with open(path) as f:
+        return [float(x) for x in f.read().split()]
+
+
+@pytest.mark.slow
+def test_two_process_bootstrap_through_launcher(tmp_path):
+    hostfile = tmp_path / "hostfile"
+    # two "hosts" resolving to this machine: the launcher's ssh path spawns
+    # local processes for localhost addresses
+    hostfile.write_text("localhost slots=1\n127.0.0.1 slots=1\n")
+    port = _free_port()
+    out = str(tmp_path / "losses")
+
+    cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+           "-H", str(hostfile), "--master_addr", "127.0.0.1",
+           "--master_port", str(port), WORKER]
+    result = subprocess.run(
+        cmd, cwd=REPO, env=_worker_env(out, local_devices=4),
+        capture_output=True, text=True, timeout=600)
+    assert result.returncode == 0, \
+        f"launcher failed\nstdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+
+    l0 = _read_losses(f"{out}.rank0")
+    l1 = _read_losses(f"{out}.rank1")
+    # both processes drive the SAME global program: identical losses
+    np.testing.assert_allclose(l0, l1, rtol=0, atol=0)
+
+    # and the 2-process × 4-device result matches one process × 8 devices
+    ref_out = str(tmp_path / "ref")
+    ref = subprocess.run(
+        [sys.executable, WORKER], cwd=REPO,
+        env=_worker_env(ref_out, local_devices=8),
+        capture_output=True, text=True, timeout=600)
+    assert ref.returncode == 0, ref.stderr
+    ref_losses = _read_losses(f"{ref_out}.rank0")
+    np.testing.assert_allclose(l0, ref_losses, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_single_host_local_launch_path():
+    """The launcher's single-host path (no hostfile → exec locally) runs the
+    worker unchanged (reference ``launcher/runner.py:377`` local branch)."""
+    result = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "-H", "/nonexistent/hostfile", WORKER],
+        cwd=REPO, env=_worker_env("", local_devices=8),
+        capture_output=True, text=True, timeout=600)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    assert "process 0/1" in result.stdout

@@ -414,10 +414,14 @@ def send(tensor, dst, group=None, tag=0):
     is statically resolvable to one mesh-axis permute: the matched pair
     lowers to ONE :func:`p2p` collective (rank ``dst``'s ``recv`` returns
     rank ``src``'s ``x``; every other rank keeps its ``buf``).  Endpoints
-    must be Python ints and the pair must match on group and tag —
-    genuinely dynamic patterns (traced endpoints, rank-divergent control
-    flow, unmatched halves) still raise with guidance, because no single
-    SPMD program can express them."""
+    must be Python ints and each ``recv`` pairs with the OLDEST pending
+    ``send`` (FIFO, like tag-free torch p2p ordering), matching on group
+    and tag.  Genuinely dynamic patterns (traced endpoints, a ``recv``
+    with no pending ``send``, group/tag mismatches) raise with guidance,
+    because no single SPMD program can express them.  A ``send`` whose
+    ``recv`` never executes cannot be detected at trace time; its entry
+    stays queued, and a later ``recv`` pairing with it across an
+    aborted/finished trace fails loudly with JAX's leaked-tracer error."""
     if not any(_is_traced(l) for l in jax.tree.leaves(tensor)):
         raise NotImplementedError(
             "send/recv are compiled collectives here: call the pair inside "
@@ -427,13 +431,6 @@ def send(tensor, dst, group=None, tag=0):
             "send(dst=...) must be a static Python int: a traced endpoint "
             "is rank-dynamic and has no single-program SPMD lowering — "
             "use dist.p2p/ppermute to express the whole exchange")
-    if _pending_send:
-        # an aborted trace (error between send and recv) may leave a stale
-        # entry holding a dead tracer; raising here would poison every
-        # later pair, so drop it with a warning instead
-        logger.warning("send(): dropping an unmatched previous send "
-                       "(aborted trace, or a send that was never recv'd)")
-        _pending_send.clear()
     _pending_send.append((tensor, int(dst), _axes(group), tag))
     return tensor
 
@@ -449,7 +446,7 @@ def recv(tensor, src, group=None, tag=0):
             "the exchange execute on every rank — call send(x, dst) then "
             "recv(buf, src) in the same traced function, or use "
             "dist.p2p(tensor, src, dst, group) directly")
-    sent, dst, saxes, stag = _pending_send.pop()
+    sent, dst, saxes, stag = _pending_send.pop(0)     # FIFO pairing
     if not isinstance(src, int):
         raise NotImplementedError(
             "recv(src=...) must be a static Python int (see send())")

@@ -369,8 +369,9 @@ class PipelineEngine(DeepSpeedEngine):
                          if e["params"] is not None]
         # only PRE-sourced ties need threading; a tie between two post
         # layers resolves naturally inside run_chain's `seen`
+        pre_set = set(pre_param_idx)
         tied_idx = sorted({e["reuse_of"] for e in self._post
-                           if e["reuse_of"] in set(pre_param_idx)})
+                           if e["reuse_of"] in pre_set})
         tied_pos = [pre_param_idx.index(i) for i in tied_idx]
         tied_cast = [pre_cast[p] for p in tied_pos]
 

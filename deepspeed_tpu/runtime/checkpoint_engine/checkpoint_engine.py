@@ -84,6 +84,20 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             arrays = self._checkpointer().restore(arrays_path, abstract_arrays)
         return arrays, meta
 
+    def metadata(self, path):
+        """Shapes/dtypes of the saved arrays (no data read) — lets a FRESH
+        engine build device-agnostic restore targets, so a checkpoint saved
+        by a different process/device topology (e.g. 2 hosts × 4 chips)
+        loads on the current one (1 host × 8): Orbax otherwise restores
+        onto the devices recorded at save time."""
+        arrays_path = os.path.join(os.path.abspath(path), "arrays")
+        if not os.path.isdir(arrays_path):
+            return None
+        md = self._checkpointer().metadata(arrays_path)
+        # unwrap StepMetadata/TreeMetadata to the plain ArrayMetadata pytree
+        item = getattr(md, "item_metadata", md)
+        return getattr(item, "tree", item)
+
     def commit(self, tag):
         if self._ckptr is not None:
             self._ckptr.wait_until_finished()

@@ -909,6 +909,55 @@ class DeepSpeedEngine:
         log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
         return True
 
+    def _metadata_restore_targets(self, md):
+        """Restore targets for a FRESH engine from checkpoint metadata:
+        build this engine's sharding plan from the saved module shapes,
+        then aim every congruent subtree (params, Adam moments in their
+        restored plain-tree form) straight at plan shardings — each device
+        reads only its shard, no replicated materialization."""
+        from deepspeed_tpu.runtime.zero.partition import spec_or_replicated
+        mod_abs = jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype),
+            md["module"])
+        self._build_plan(mod_abs)
+        params_def = jax.tree.structure(mod_abs)
+        rep = NamedSharding(self.mesh, P())
+
+        def congruent_shardings(sub):
+            try:
+                if jax.tree.structure(sub) == params_def:
+                    return jax.tree.map(
+                        lambda s, leaf: spec_or_replicated(self.mesh, s,
+                                                           leaf),
+                        self._plan.opt_specs, sub,
+                        is_leaf=lambda x: isinstance(x, P))
+            except Exception:
+                pass
+            if isinstance(sub, dict):
+                return {k: congruent_shardings(v) for k, v in sub.items()}
+            if isinstance(sub, (list, tuple)):
+                return type(sub)(congruent_shardings(v) for v in sub)
+            return rep
+
+        def with_sh(md_tree, sh_tree):
+            return jax.tree.map(
+                lambda m, s: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype,
+                                                  sharding=s),
+                md_tree, sh_tree)
+
+        targets = {"module": with_sh(md["module"],
+                                     self._plan.param_shardings)}
+        for key in md:
+            if key == "module":
+                continue
+            if md[key] is None:           # e.g. offload engines save no
+                targets[key] = None       # device optimizer state
+                continue
+            sh = congruent_shardings(md[key]) if key == "optimizer" \
+                else jax.tree.map(lambda _: rep, md[key])
+            targets[key] = with_sh(md[key], sh)
+        return targets
+
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
@@ -927,6 +976,18 @@ class DeepSpeedEngine:
                 "optimizer": _abstract_like(self._opt_state),
                 "loss_scaler": _abstract_like(self._scaler_state),
             }
+        else:
+            # fresh engine: the checkpoint may come from a DIFFERENT
+            # process/device topology (cross-world-size resume) — build
+            # device-agnostic restore targets from the checkpoint's own
+            # metadata, SHARDED under this engine's plan (built from the
+            # checkpoint's shapes) so a ZeRO-sized model never
+            # materializes replicated during the restore
+            md = getattr(self.checkpoint_engine, "metadata",
+                         lambda p: None)(path)
+            if md is not None:
+                abstract = self._metadata_restore_targets(md)
+        fresh_engine = self._params is None
         arrays, meta = self.checkpoint_engine.load(path, abstract_arrays=abstract)
         self._params = arrays["module"]
         if load_module_only:
@@ -964,7 +1025,7 @@ class DeepSpeedEngine:
         self.skipped_steps = meta.get("skipped_steps", 0)
         if load_lr_scheduler_states and self.lr_scheduler and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
-        if self._plan is None and self._host_opt is None:
+        if fresh_engine and self._host_opt is None:
             # checkpoint loaded into a fresh engine, possibly on a DIFFERENT
             # topology than it was saved from (the reference's universal-
             # checkpoint resize): build this engine's sharding plan from the

@@ -57,9 +57,26 @@ engine, *_ = deepspeed_tpu.initialize(
 rng = np.random.default_rng(0)
 batch = {"input_ids": rng.integers(
     0, 64, (1, 2 * engine.topology.dp, 16)).astype(np.int32)}
+
+# cross-world-size checkpoint flow (the reference's DistributedFixture
+# pattern, tests/unit/common.py:215: produce at one world size, consume at
+# another): WORKER_LOAD_DIR resumes before stepping, WORKER_SAVE_DIR
+# checkpoints after the first two steps
+load_dir = os.environ.get("WORKER_LOAD_DIR")
+if load_dir:
+    engine.load_checkpoint(load_dir)
+    print(f"[worker] resumed at global_steps={engine.global_steps}",
+          flush=True)
+
 losses = []
 for _ in range(2):
     loss = engine.train_batch(batch=batch)
+    losses.append(float(jax.device_get(loss)))
+
+save_dir = os.environ.get("WORKER_SAVE_DIR")
+if save_dir:
+    engine.save_checkpoint(save_dir)
+    loss = engine.train_batch(batch=batch)   # one post-save step
     losses.append(float(jax.device_get(loss)))
 print(f"[worker] rank {rank} losses: {losses}", flush=True)
 

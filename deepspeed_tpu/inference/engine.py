@@ -230,14 +230,15 @@ class InferenceEngine:
     __call__ = forward
 
     def _get_generate(self, prompt_len, max_new_tokens, do_sample, temperature,
-                      top_k, top_p):
-        key = ("gen", prompt_len, max_new_tokens, do_sample, temperature, top_k, top_p)
+                      top_k, top_p, with_mask=False):
+        key = ("gen", prompt_len, max_new_tokens, do_sample, temperature,
+               top_k, top_p, with_mask)
         if key in self._compiled:
             return self._compiled[key]
         self._compiled[key] = make_generate_fn(
             self.module, self.compute_dtype, prompt_len, max_new_tokens,
             do_sample, temperature, top_k, top_p,
-            param_transform=self._deq)
+            param_transform=self._deq, with_mask=with_mask)
         return self._compiled[key]
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
@@ -247,22 +248,33 @@ class InferenceEngine:
         — prompt followed by new tokens, the HF ``generate`` contract
         (reference ``engine._generate :614``).
 
-        Prompts must be unpadded (equal length per batch row) — the cached
-        decode path has no padding mask yet.
+        ``attention_mask`` supports RIGHT-padded prompts (1 = real token):
+        each row continues from its own prompt length; generated tokens
+        occupy the trailing ``max_new_tokens`` columns of the result while
+        the prompt columns (including pads) stay in place.
         """
-        if attention_mask is not None:
-            raise NotImplementedError(
-                "generate() requires unpadded prompts; attention_mask is not "
-                "supported in the cached decode path yet")
         assert self._params is not None, "no parameters: set_params/init_params first"
         input_ids = jnp.asarray(input_ids)
+        if attention_mask is not None:
+            # only RIGHT padding is supported (each row: 1s then 0s); HF
+            # tokenizers default decoder-only generation to LEFT padding,
+            # which would silently index mid-prompt logits here
+            m = np.asarray(attention_mask)
+            if not (np.diff(m.astype(np.int8), axis=1) <= 0).all():
+                raise ValueError(
+                    "attention_mask must be RIGHT-padded (1s then 0s per "
+                    "row); re-tokenize with padding_side='right'")
         if seed is not None:
             self._rng = jax.random.key(seed)
         self._rng, rng = jax.random.split(self._rng)
         fn = self._get_generate(input_ids.shape[1], int(max_new_tokens),
                                 bool(do_sample), float(temperature), int(top_k),
-                                float(top_p))
-        return fn(self._params, input_ids, rng, jnp.asarray(eos_token_id))
+                                float(top_p),
+                                with_mask=attention_mask is not None)
+        args = (self._params, input_ids, rng, jnp.asarray(eos_token_id))
+        if attention_mask is not None:
+            args += (jnp.asarray(attention_mask),)
+        return fn(*args)
 
 
 def _unflatten_flax_paths(flat):
@@ -281,12 +293,20 @@ def _unflatten_flax_paths(flat):
 
 def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
                      do_sample, temperature, top_k, top_p,
-                     param_transform=None):
+                     param_transform=None, with_mask=False):
     """Build the jitted generation program: one-pass prefill + lax.scan
     decode loop with greedy / temperature / top-k / top-p sampling.  Shared
     by ``InferenceEngine`` and ``DeepSpeedHybridEngine``.
 
-    Returns ``fn(params, input_ids, rng, eos_id) -> [B, prompt+new]``."""
+    ``with_mask=True`` supports RIGHT-padded prompts: ``fn`` takes an
+    ``attention_mask`` [B, prompt] and each row continues from its own
+    prompt length — generated tokens overwrite the pad slots in the KV
+    cache (the live region stays contiguous, which is what the Pallas
+    decode kernel's per-row length mask expects), while the returned array
+    keeps the HF layout ``[prompt columns..., generated columns...]``.
+
+    Returns ``fn(params, input_ids, rng, eos_id[, attention_mask])
+    -> [B, prompt+new]``."""
     max_len = prompt_len + max_new_tokens
 
     def sample_fn(logits, rng):
@@ -307,7 +327,7 @@ def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
             logits = jnp.where(logits < cutoff, -1e30, logits)
         return jax.random.categorical(rng, logits, axis=-1)
 
-    def generate(params, input_ids, rng, eos_id):
+    def generate(params, input_ids, rng, eos_id, attention_mask=None):
         deq = param_transform if param_transform is not None else (lambda p: p)
         B = input_ids.shape[0]
         cache = module.init_cache(B, max_len, dtype=compute_dtype)
@@ -315,7 +335,18 @@ def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
         logits, cache = module.apply(deq(params), input_ids, cache, 0,
                                      method=type(module).decode)
         rng, sub = jax.random.split(rng)
-        next_tok = sample_fn(logits[:, -1], sub)
+        if with_mask:
+            # right-padded rows: each row's next token comes from its LAST
+            # REAL position and decoding continues at per-row offsets
+            n = jnp.sum(attention_mask.astype(jnp.int32), axis=1)   # [B]
+            last = jnp.take_along_axis(logits, (n - 1)[:, None, None],
+                                       axis=1)[:, 0]
+            pos0 = n
+        else:
+            last = logits[:, -1]
+            # scalar position: keeps the row-uniform cache-write fast path
+            pos0 = jnp.asarray(prompt_len, jnp.int32)
+        next_tok = sample_fn(last, sub)
 
         # the quantized tree rides the scan CARRY and is dequantized inside
         # the body: at the JAX level the compute-dtype weights are a per-step
@@ -332,8 +363,7 @@ def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
 
         done0 = (next_tok == eos_id)
         (_, _, _, _, _, _), toks = jax.lax.scan(
-            step, (next_tok, cache, jnp.asarray(prompt_len), rng, done0,
-                   params),
+            step, (next_tok, cache, pos0, rng, done0, params),
             None, length=max_new_tokens - 1)
         # HF contract: prompt + generated tokens
         return jnp.concatenate([input_ids, next_tok[:, None], toks.T], axis=1)

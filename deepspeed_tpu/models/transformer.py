@@ -431,10 +431,37 @@ class Attention(nn.Module):
             # cache; cache layout is [.., S_max, KVH*D] (S-major, heads
             # flattened — the decode kernel's full-lane-width DMA layout;
             # the write below is the raw projection output, no transpose)
-            start = positions[0, 0]
             B_, S_ = k.shape[0], k.shape[1]
             k_new = k.reshape(B_, S_, KVH * D)
             v_new = v.reshape(B_, S_, KVH * D)
+            if S_ == 1 and "per_row" in cache:
+                # padded-prompt decode: each row writes at ITS OWN position
+                # (generated tokens overwrite the right-pad slots, keeping
+                # the live cache region contiguous for the decode kernel's
+                # length mask).  One native scatter — NOT the default path:
+                # the row-uniform dynamic_update_slice below is cheaper and
+                # proven on the big stacked cache.
+                pos_rows = positions[:, 0]
+                rows = jnp.arange(B_)
+
+                def write_rows(buf, new, li=None):
+                    # buf [L, B, S, KD] or [B, S, KD], new [B, 1, KD]
+                    if li is None:
+                        return buf.at[rows, pos_rows].set(
+                            new[:, 0].astype(buf.dtype))
+                    return buf.at[li, rows, pos_rows].set(
+                        new[:, 0].astype(buf.dtype))
+            else:
+                # row-uniform write: decode at a shared position, or a
+                # multi-token prefill block from the start position
+                start = positions[0, 0]
+
+                def write_rows(buf, new, li=None):
+                    if li is None:
+                        return jax.lax.dynamic_update_slice(
+                            buf, new.astype(buf.dtype), (0, start, 0))
+                    return jax.lax.dynamic_update_slice(
+                        buf, new[None].astype(buf.dtype), (li, 0, start, 0))
             if "layer" in cache:
                 # stacked-carry decode: the FULL [L, B, S_max, KVH*D]
                 # cache rides the layer-scan carry and only this step's
@@ -443,23 +470,19 @@ class Attention(nn.Module):
                 # cache every decode step).  The Pallas decode kernel
                 # indexes the layer itself, so no slice materializes.
                 li = cache["layer"]
-                k_full = jax.lax.dynamic_update_slice(
-                    cache["k"], k_new[None].astype(cache["k"].dtype),
-                    (li, 0, start, 0))
-                v_full = jax.lax.dynamic_update_slice(
-                    cache["v"], v_new[None].astype(cache["v"].dtype),
-                    (li, 0, start, 0))
+                k_full = write_rows(cache["k"], k_new, li)
+                v_full = write_rows(cache["v"], v_new, li)
                 out = cached_attention(q, k_full, v_full, positions,
                                        bias=bias, window=window, layer=li)
-                new_cache = {"k": k_full, "v": v_full, "layer": li}
+                new_cache = {"k": k_full, "v": v_full, "layer": li,
+                             **({"per_row": cache["per_row"]}
+                                if "per_row" in cache else {})}
             else:
-                k_cache = jax.lax.dynamic_update_slice(
-                    cache["k"], k_new.astype(cache["k"].dtype),
-                    (0, start, 0))
-                v_cache = jax.lax.dynamic_update_slice(
-                    cache["v"], v_new.astype(cache["v"].dtype),
-                    (0, start, 0))
-                new_cache = {"k": k_cache, "v": v_cache}
+                k_cache = write_rows(cache["k"], k_new)
+                v_cache = write_rows(cache["v"], v_new)
+                new_cache = {"k": k_cache, "v": v_cache,
+                             **({"per_row": cache["per_row"]}
+                                if "per_row" in cache else {})}
                 out = cached_attention(q, k_cache, v_cache, positions,
                                        bias=bias, window=window)
         else:
@@ -629,7 +652,16 @@ class Transformer(nn.Module):
                       with_aux=False, train=True):
         cfg = self.config
         B, S = input_ids.shape
-        positions = start_pos + jnp.broadcast_to(jnp.arange(S), (B, S))
+        # start_pos: scalar, or [B] per-row offsets (padded-prompt decode —
+        # each row continues from its own prompt length).  The RANK of
+        # start_pos statically selects the cache-write path: per-row
+        # offsets need a scatter, the shared-position fast path keeps the
+        # proven dynamic_update_slice (see Attention).
+        start = jnp.asarray(start_pos)
+        per_row_pos = start.ndim >= 1
+        if start.ndim == 1:
+            start = start[:, None]
+        positions = start + jnp.broadcast_to(jnp.arange(S), (B, S))
         x = self.embed_tokens(input_ids).astype(cfg.jnp_dtype)
         if cfg.embed_proj_dim is not None:
             x = self.project_in(x)
@@ -637,10 +669,11 @@ class Transformer(nn.Module):
             x = x + self.embed_positions(positions).astype(cfg.jnp_dtype)
         if cfg.embedding_norm:
             x = self.embed_norm(x).astype(cfg.jnp_dtype)
+        marker = {"per_row": jnp.zeros((), jnp.int32)} if per_row_pos else {}
         if cfg.scan_layers:
             carry_cache = None if cache is None else \
                 {"k": cache["k"], "v": cache["v"],
-                 "layer": jnp.asarray(0, jnp.int32)}
+                 "layer": jnp.asarray(0, jnp.int32), **marker}
             (x, out_cache), aux_layers = self.blocks((x, carry_cache),
                                                      positions, mask)
             aux = jnp.sum(aux_layers)
@@ -654,7 +687,7 @@ class Transformer(nn.Module):
             for i, blk in enumerate(self.block_list):
                 layer_cache = None if cur is None else \
                     {"k": cur["k"], "v": cur["v"],
-                     "layer": jnp.asarray(i, jnp.int32)}
+                     "layer": jnp.asarray(i, jnp.int32), **marker}
                 # train positional: static_argnums only covers positionals
                 x, nc, a = blk(x, positions, mask, layer_cache, train)
                 if cur is not None:

@@ -916,9 +916,12 @@ class DeepSpeedEngine:
         restored plain-tree form) straight at plan shardings — each device
         reads only its shard, no replicated materialization."""
         from deepspeed_tpu.runtime.zero.partition import spec_or_replicated
-        mod_abs = jax.tree.map(
-            lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype),
-            md["module"])
+        # Orbax ArrayMetadata leaves carry shape/dtype but no ndim — map to
+        # ShapeDtypeStructs up front so downstream spec decisions (which
+        # rank-check leaves) see real abstract arrays
+        abstract = jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype), md)
+        mod_abs = abstract["module"]
         self._build_plan(mod_abs)
         params_def = jax.tree.structure(mod_abs)
         rep = NamedSharding(self.mesh, P())
@@ -939,23 +942,22 @@ class DeepSpeedEngine:
                 return type(sub)(congruent_shardings(v) for v in sub)
             return rep
 
-        def with_sh(md_tree, sh_tree):
+        def with_sh(abs_tree, sh_tree):
             return jax.tree.map(
-                lambda m, s: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype,
+                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
                                                   sharding=s),
-                md_tree, sh_tree)
+                abs_tree, sh_tree)
 
-        targets = {"module": with_sh(md["module"],
-                                     self._plan.param_shardings)}
-        for key in md:
+        targets = {"module": with_sh(mod_abs, self._plan.param_shardings)}
+        for key in abstract:
             if key == "module":
                 continue
-            if md[key] is None:           # e.g. offload engines save no
+            if abstract[key] is None:     # e.g. offload engines save no
                 targets[key] = None       # device optimizer state
                 continue
-            sh = congruent_shardings(md[key]) if key == "optimizer" \
-                else jax.tree.map(lambda _: rep, md[key])
-            targets[key] = with_sh(md[key], sh)
+            sh = congruent_shardings(abstract[key]) if key == "optimizer" \
+                else jax.tree.map(lambda _: rep, abstract[key])
+            targets[key] = with_sh(abstract[key], sh)
         return targets
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
@@ -991,9 +993,11 @@ class DeepSpeedEngine:
         arrays, meta = self.checkpoint_engine.load(path, abstract_arrays=abstract)
         self._params = arrays["module"]
         if load_module_only:
-            if self._plan is None and self._host_opt is None:
+            if fresh_engine and self._host_opt is None:
                 # fresh engine: build the plan and re-place the loaded
-                # weights (fresh optimizer state — module only)
+                # weights (fresh optimizer state — module only; the
+                # metadata path may have pre-built self._plan, so key on
+                # fresh_engine, not plan presence)
                 self._init_params_from(self._params)
             elif self._host_opt is not None:
                 # fresh masters from the loaded weights — stale fp32 masters

@@ -241,3 +241,52 @@ def test_per_channel_int8_inference():
     with pytest.raises(ValueError, match="per_channel"):
         from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
         WeightQuantization(bits=4, per_channel=True)
+
+
+def test_padded_prompt_generation_matches_per_row():
+    """Right-padded batched generation (attention_mask) must produce, for
+    every row, exactly the tokens that an unpadded single-row generate
+    produces (reference ``engine._generate`` handles HF padded batches)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+
+    cfg = tiny_cfg()
+    model = Transformer(cfg)
+    rng = np.random.default_rng(3)
+    lens = [5, 12, 9]
+    P = max(lens)
+    rows = [rng.integers(1, 97, (n,)).astype(np.int32) for n in lens]
+    ids = np.zeros((len(rows), P), np.int32)
+    mask = np.zeros((len(rows), P), np.int32)
+    for i, r in enumerate(rows):
+        ids[i, :len(r)] = r
+        mask[i, :len(r)] = 1
+    params = model.init(jax.random.key(0),
+                        {"input_ids": jnp.asarray(ids)})
+    eng = InferenceEngine(model, DeepSpeedInferenceConfig(dtype="float32"),
+                          params=params)
+
+    out = np.asarray(eng.generate(ids, max_new_tokens=6,
+                                  attention_mask=mask))
+    assert out.shape == (3, P + 6)
+    # prompt columns (incl. pads) unchanged
+    np.testing.assert_array_equal(out[:, :P], ids)
+    for i, r in enumerate(rows):
+        solo = np.asarray(eng.generate(r[None], max_new_tokens=6))
+        np.testing.assert_array_equal(
+            out[i, P:], solo[0, len(r):],
+            err_msg=f"row {i} (len {len(r)}) diverges from unpadded run")
+
+
+def test_left_padded_mask_rejected(model_and_params):
+    """LEFT padding (HF's decoder-only default) silently corrupts the
+    right-pad decode layout — it must be rejected loudly."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    model, params, ids = model_and_params
+    eng = InferenceEngine(model, DeepSpeedInferenceConfig(dtype="float32"),
+                          params=params)
+    mask = np.ones(ids.shape, np.int32)
+    mask[0, :3] = 0                      # left padding on row 0
+    with pytest.raises(ValueError, match="RIGHT-padded"):
+        eng.generate(ids, max_new_tokens=2, attention_mask=mask)

@@ -256,14 +256,7 @@ class InferenceEngine:
         assert self._params is not None, "no parameters: set_params/init_params first"
         input_ids = jnp.asarray(input_ids)
         if attention_mask is not None:
-            # only RIGHT padding is supported (each row: 1s then 0s); HF
-            # tokenizers default decoder-only generation to LEFT padding,
-            # which would silently index mid-prompt logits here
-            m = np.asarray(attention_mask)
-            if not (np.diff(m.astype(np.int8), axis=1) <= 0).all():
-                raise ValueError(
-                    "attention_mask must be RIGHT-padded (1s then 0s per "
-                    "row); re-tokenize with padding_side='right'")
+            require_right_padded(attention_mask)
         if seed is not None:
             self._rng = jax.random.key(seed)
         self._rng, rng = jax.random.split(self._rng)
@@ -289,6 +282,21 @@ def _unflatten_flax_paths(flat):
     return unflatten_params(
         {(k if k.startswith("params/") else f"params/{k}"): v
          for k, v in flat.items()})
+
+
+def require_right_padded(attention_mask):
+    """Validate a generation attention_mask at the API boundary: every row
+    must be RIGHT-padded (1s then 0s) and non-empty — HF tokenizers default
+    decoder-only generation to LEFT padding, which would silently index
+    mid-prompt logits, and an all-pad row would condition on pad logits."""
+    m = np.asarray(attention_mask)
+    if not (np.diff(m.astype(np.int8), axis=1) <= 0).all():
+        raise ValueError(
+            "attention_mask must be RIGHT-padded (1s then 0s per row); "
+            "re-tokenize with padding_side='right'")
+    if (m.sum(axis=1) == 0).any():
+        raise ValueError("attention_mask has an all-padding row (empty "
+                         "prompt) — drop it before generate()")
 
 
 def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,

@@ -160,24 +160,34 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
     # ------------------------------------------------------------------ #
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=-1,
-                 seed=None):
-        from deepspeed_tpu.inference.engine import make_generate_fn
+                 seed=None, attention_mask=None):
+        """Rollout generation over the shared weights.  ``attention_mask``
+        supports RIGHT-padded prompt batches — the usual RLHF rollout input
+        (see ``InferenceEngine.generate`` for the layout contract)."""
+        from deepspeed_tpu.inference.engine import (make_generate_fn,
+                                                    require_right_padded)
         import time
         t0 = time.time()
         input_ids = jnp.asarray(input_ids)
+        if attention_mask is not None:
+            require_right_padded(attention_mask)
         if seed is not None:
             self._gen_rng = jax.random.key(seed)
         self._gen_rng, rng = jax.random.split(self._gen_rng)
         key = (input_ids.shape[1], int(max_new_tokens), bool(do_sample),
-               float(temperature), int(top_k), float(top_p))
+               float(temperature), int(top_k), float(top_p),
+               attention_mask is not None)
         if key not in self._gen_compiled:
             self._gen_compiled[key] = make_generate_fn(
                 self.module, self.compute_dtype, input_ids.shape[1],
                 int(max_new_tokens), bool(do_sample), float(temperature),
-                int(top_k), float(top_p))
+                int(top_k), float(top_p),
+                with_mask=attention_mask is not None)
         params = self._inference_view()
-        out = self._gen_compiled[key](params, input_ids, rng,
-                                      jnp.asarray(eos_token_id))
+        args = (params, input_ids, rng, jnp.asarray(eos_token_id))
+        if attention_mask is not None:
+            args += (jnp.asarray(attention_mask),)
+        out = self._gen_compiled[key](*args)
         out.block_until_ready()
         self._generate_latency += time.time() - t0
         return out

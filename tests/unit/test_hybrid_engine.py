@@ -114,3 +114,25 @@ def test_lora_fuse_unfuse_roundtrip():
             jax.tree_util.tree_flatten_with_path(before)[0],
             jax.tree_util.tree_flatten_with_path(after)[0]):
         np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=1e-5)
+
+
+def test_hybrid_rollout_with_padded_prompts():
+    """RLHF rollouts take right-padded prompt batches: each row's
+    continuation matches its unpadded single-row rollout (greedy)."""
+    import numpy as np
+    engine = make_hybrid(zero_stage=2)
+    rng = np.random.default_rng(11)
+    lens = [4, 9, 6]
+    P = max(lens)
+    ids = np.zeros((3, P), np.int32)
+    mask = np.zeros((3, P), np.int32)
+    for i, n in enumerate(lens):
+        ids[i, :n] = rng.integers(1, engine.module.config.vocab_size, (n,))
+        mask[i, :n] = 1
+    out = np.asarray(engine.generate(ids, max_new_tokens=5,
+                                     attention_mask=mask))
+    assert out.shape == (3, P + 5)
+    for i, n in enumerate(lens):
+        solo = np.asarray(engine.generate(ids[i:i + 1, :n],
+                                          max_new_tokens=5))
+        np.testing.assert_array_equal(out[i, P:], solo[0, n:])

@@ -195,3 +195,51 @@ def test_moe_trunk_checkpoint_roundtrip(tmp_path):
     leaves = jax.tree_util.tree_leaves_with_path(engine2.params)
     expert = [l for p, l in leaves if "experts" in str(p).lower()]
     assert expert and any("ep" in str(l.sharding.spec) for l in expert)
+
+
+def test_moe_checkpoint_across_ep_sizes(tmp_path):
+    """Elastic expert-parallel resize (the reference's
+    ``test_moe_checkpoint.py`` cross-ep_size cases): a checkpoint saved at
+    ep=4 loads into an ep=2 engine — expert-stacked params reshard onto the
+    new topology (ep sharding asserted) and training continues."""
+    from deepspeed_tpu.models.transformer import (Transformer,
+                                                  TransformerConfig)
+
+    def make(ep):
+        cfg = TransformerConfig(
+            vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+            max_seq_len=16, dtype="float32", use_flash_attention=False,
+            remat=False, scan_layers=False, moe_num_experts=4, moe_every=2,
+            moe_ep_size=ep, moe_capacity_factor=2.0)
+        conf = {"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+                "moe": {"ep_size": ep},
+                "zero_optimization": {"stage": 1}}
+        engine, *_ = deepspeed_tpu.initialize(model=Transformer(cfg),
+                                              config=conf)
+        return engine
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 64, (8, 16)).astype(np.int32)
+    e1 = make(4)
+    for _ in range(2):
+        loss = e1({"input_ids": ids})
+        e1.backward(loss)
+        e1.step()
+    e1.save_checkpoint(str(tmp_path))
+    w1 = jax.device_get(e1.params)
+
+    e2 = make(2)
+    e2.load_checkpoint(str(tmp_path))
+    jax.tree.map(np.testing.assert_array_equal, w1,
+                 jax.device_get(e2.params))
+    assert e2.global_steps == 2
+    # the values came back AND landed ep-sharded on the NEW topology
+    leaves = jax.tree_util.tree_leaves_with_path(e2.params)
+    expert = [l for p, l in leaves if "experts" in str(p).lower()]
+    assert expert and any("ep" in str(l.sharding.spec) for l in expert), \
+        "expert params not resharded over ep after cross-ep load"
+    loss = e2({"input_ids": ids})
+    e2.backward(loss)
+    e2.step()
+    assert np.isfinite(float(jax.device_get(loss)))

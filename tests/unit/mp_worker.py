@@ -38,25 +38,49 @@ rank, world = jax.process_index(), jax.process_count()
 print(f"[worker] process {rank}/{world}, local devices "
       f"{jax.local_device_count()}, global {jax.device_count()}", flush=True)
 
-cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2,
-                        num_heads=4, max_seq_len=32,
-                        use_flash_attention=False, dtype="float32",
-                        scan_layers=False, remat=False)
-engine, *_ = deepspeed_tpu.initialize(
-    model=Transformer(cfg),
-    config={
-        "train_micro_batch_size_per_gpu": 2,
-        "gradient_accumulation_steps": 1,
-        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
-        "zero_optimization": {"stage": 2},
-        "seed": 0,
-    })
-
-# every process supplies the same global batch (single-controller-per-host:
-# the engine shards it over the global mesh)
+variant = os.environ.get("WORKER_VARIANT", "zero2")
 rng = np.random.default_rng(0)
-batch = {"input_ids": rng.integers(
-    0, 64, (1, 2 * engine.topology.dp, 16)).astype(np.int32)}
+if variant == "pp":
+    # pipeline over the OUTERMOST mesh axis: with 2 processes the pp
+    # ppermutes cross the process boundary — the DCN-tier exchange of a
+    # real multi-host pipeline (reference 3D topology maps pp to the
+    # inter-node axis, runtime/pipe/topology.py)
+    from deepspeed_tpu.models.pipeline_transformer import transformer_pipe
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                            num_heads=4, max_seq_len=32,
+                            use_flash_attention=False, dtype="float32",
+                            scan_layers=False, remat=False)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=transformer_pipe(cfg),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "tensor_parallel": {"tp_size": 2},
+            "pipeline": {"stages": 2, "schedule": "1f1b"},
+            "seed": 0,
+        })
+    # microbatch dim covers micro_bs(2) x dp replicas, like the zero2 path
+    batch = {"input_ids": rng.integers(
+        0, 64, (4, 2 * engine.topology.edp, 16)).astype(np.int32)}
+else:
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_heads=4, max_seq_len=32,
+                            use_flash_attention=False, dtype="float32",
+                            scan_layers=False, remat=False)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=Transformer(cfg),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "seed": 0,
+        })
+    # every process supplies the same global batch (single-controller-per-
+    # host: the engine shards it over the global mesh)
+    batch = {"input_ids": rng.integers(
+        0, 64, (1, 2 * engine.topology.dp, 16)).astype(np.int32)}
 
 # cross-world-size checkpoint flow (the reference's DistributedFixture
 # pattern, tests/unit/common.py:215: produce at one world size, consume at

@@ -42,38 +42,51 @@ def _read_losses(path):
         return [float(x) for x in f.read().split()]
 
 
-@pytest.mark.slow
-def test_two_process_bootstrap_through_launcher(tmp_path):
+
+
+def _launch_and_compare(tmp_path, variant=None, extra_env=None,
+                        local_devices=4):
+    """Run the worker through the launcher on two local 'hosts', assert
+    both ranks produced identical losses, then reproduce them with a
+    single process on the same global mesh size."""
     hostfile = tmp_path / "hostfile"
-    # two "hosts" resolving to this machine: the launcher's ssh path spawns
-    # local processes for localhost addresses
     hostfile.write_text("localhost slots=1\n127.0.0.1 slots=1\n")
     port = _free_port()
     out = str(tmp_path / "losses")
-
-    cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
-           "-H", str(hostfile), "--master_addr", "127.0.0.1",
-           "--master_port", str(port), WORKER]
+    env = _worker_env(out, local_devices=local_devices)
+    if variant:
+        env["WORKER_VARIANT"] = variant
+    env.update(extra_env or {})
     result = subprocess.run(
-        cmd, cwd=REPO, env=_worker_env(out, local_devices=4),
-        capture_output=True, text=True, timeout=600)
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "-H", str(hostfile), "--master_addr", "127.0.0.1",
+         "--master_port", str(port), WORKER],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
     assert result.returncode == 0, \
         f"launcher failed\nstdout:\n{result.stdout}\nstderr:\n{result.stderr}"
-
     l0 = _read_losses(f"{out}.rank0")
     l1 = _read_losses(f"{out}.rank1")
-    # both processes drive the SAME global program: identical losses
     np.testing.assert_allclose(l0, l1, rtol=0, atol=0)
 
-    # and the 2-process × 4-device result matches one process × 8 devices
     ref_out = str(tmp_path / "ref")
+    env = _worker_env(ref_out, local_devices=2 * local_devices)
+    if variant:
+        env["WORKER_VARIANT"] = variant
     ref = subprocess.run(
-        [sys.executable, WORKER], cwd=REPO,
-        env=_worker_env(ref_out, local_devices=8),
+        [sys.executable, WORKER], cwd=REPO, env=env,
         capture_output=True, text=True, timeout=600)
-    assert ref.returncode == 0, ref.stderr
-    ref_losses = _read_losses(f"{ref_out}.rank0")
-    np.testing.assert_allclose(l0, ref_losses, rtol=1e-4)
+    assert ref.returncode == 0, \
+        f"reference run failed\nstdout:\n{ref.stdout}\nstderr:\n{ref.stderr}"
+    np.testing.assert_allclose(l0, _read_losses(f"{ref_out}.rank0"),
+                               rtol=1e-4)
+    return l0
+
+@pytest.mark.slow
+def test_two_process_bootstrap_through_launcher(tmp_path):
+    """Two launcher-spawned OS processes (4 devices each) rendezvous via
+    jax.distributed.initialize into one 8-device mesh, run ZeRO-2 steps,
+    and match the single-process 8-device run."""
+    _launch_and_compare(tmp_path)
 
 
 @pytest.mark.slow
@@ -128,3 +141,14 @@ def test_checkpoint_across_world_sizes(tmp_path):
     # the resumed first step must reproduce the 2-process run's post-save
     # step exactly (same data stream, same fold_in(step) rng)
     np.testing.assert_allclose(resumed[0], two_proc[2], rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_pipeline_across_processes(tmp_path):
+    """3D parallelism with the pipeline axis CROSSING the process boundary:
+    pp=2 x tp=2 x dp=2 on 2 launcher-spawned processes (4 devices each) —
+    the pp ppermutes ride the inter-process (DCN-tier) link, the way a real
+    multi-host pipeline maps stages to nodes (reference
+    ``runtime/pipe/topology.py`` 3D axis order).  Losses must match the
+    single-process 8-device run exactly."""
+    _launch_and_compare(tmp_path, variant="pp")

@@ -68,15 +68,25 @@ else:
                             num_heads=4, max_seq_len=32,
                             use_flash_attention=False, dtype="float32",
                             scan_layers=False, remat=False)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "seed": 0,
+    }
+    if variant == "sp":
+        # ring attention over the FULL device set (sp=8, dp=1): edp is
+        # outer to sp in the mesh axis order, so only a full-width ring
+        # actually spans both processes' devices — the KV-rotation
+        # ppermutes then cross the process boundary (context parallelism
+        # at DCN tier)
+        import dataclasses
+        cfg = dataclasses.replace(cfg, sequence_parallel_impl="ring")
+        config["sequence_parallel"] = {"sp_size": 8}
     engine, *_ = deepspeed_tpu.initialize(
         model=Transformer(cfg),
-        config={
-            "train_micro_batch_size_per_gpu": 2,
-            "gradient_accumulation_steps": 1,
-            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
-            "zero_optimization": {"stage": 2},
-            "seed": 0,
-        })
+        config=config)
     # every process supplies the same global batch (single-controller-per-
     # host: the engine shards it over the global mesh)
     batch = {"input_ids": rng.integers(

@@ -44,8 +44,7 @@ def _read_losses(path):
 
 
 
-def _launch_and_compare(tmp_path, variant=None, extra_env=None,
-                        local_devices=4):
+def _launch_and_compare(tmp_path, variant=None, local_devices=4):
     """Run the worker through the launcher on two local 'hosts', assert
     both ranks produced identical losses, then reproduce them with a
     single process on the same global mesh size."""
@@ -56,7 +55,6 @@ def _launch_and_compare(tmp_path, variant=None, extra_env=None,
     env = _worker_env(out, local_devices=local_devices)
     if variant:
         env["WORKER_VARIANT"] = variant
-    env.update(extra_env or {})
     result = subprocess.run(
         [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
          "-H", str(hostfile), "--master_addr", "127.0.0.1",
@@ -152,3 +150,16 @@ def test_pipeline_across_processes(tmp_path):
     ``runtime/pipe/topology.py`` 3D axis order).  Losses must match the
     single-process 8-device run exactly."""
     _launch_and_compare(tmp_path, variant="pp")
+
+
+@pytest.mark.slow
+def test_ring_attention_across_processes(tmp_path):
+    """Sequence parallelism with the sp axis spanning both processes (sp=8 —
+    a narrower ring would nest inside one process, since edp is outer to
+    sp in the mesh): ring attention's KV-rotation ppermutes cross the
+    process boundary
+    (context parallelism at the DCN tier — the reference scales long
+    sequences with its sparse-attention kernels; ring attention is this
+    framework's SP superset, SURVEY §2.3).  Losses must match the
+    single-process 8-device run."""
+    _launch_and_compare(tmp_path, variant="sp")

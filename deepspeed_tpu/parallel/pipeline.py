@@ -27,9 +27,11 @@ Schedule menu (``pipeline.schedule`` + ``max_in_flight_microbatches``):
   bounds in-flight microbatches to ~P.
 * ``spmd_pipeline_1f1b`` (schedule="1f1b") — hand-rolled interleaved
   one-forward-one-backward ticks with an O(P) input ring and in-region
-  boundary layers; bubble ``2(P-1)/(M+2(P-1))`` (see
-  ``one_f_one_b_ticks`` for why SPMD lockstep pays P-1 extra ticks vs the
-  reference's asynchronous schedule).  The memory-bounded mode of choice.
+  boundary layers, staged as three scans (P-1 forward-only warmup ticks,
+  M combined fwd+bwd steady ticks, P-1 backward-only cooldown ticks) so
+  the fill/drain ticks cost only their live half.  Bubble
+  ``(P-1)/(M+P-1)`` — the reference ``TrainSchedule`` number (see
+  ``one_f_one_b_phase_ticks``).  The memory-bounded mode of choice.
 * chunked accumulation (``max_in_flight_microbatches=C``) — fill-drain
   over chunks of C; O(C) stash at a per-chunk bubble ``(P-1)/(C+P-1)``.
   Kept for when C must be tuned independently of P.
@@ -131,21 +133,32 @@ def pipeline_bubble_fraction(num_micro, num_stages):
 
 
 def one_f_one_b_ticks(num_micro, num_stages):
-    """Tick count of the interleaved 1F1B schedule: M + 2(P-1).
+    """Total scan-tick count of the interleaved 1F1B schedule: M + 2(P-1).
 
-    Each tick performs one forward AND one (rematerialized) backward unit
-    per stage, so the schedule's bubble fraction is
-    ``2(P-1) / (M + 2(P-1))``.  Relative to the reference's asynchronous
-    1F1B (``runtime/pipe/schedule.py:189``, bubble (P-1)/(M+P-1)): an SPMD
-    program executes every stage's tick in lockstep, so the backward
-    wavefront's extra P-1 ticks of latency cannot hide inside other stages'
-    forward slots — the lockstep schedule pays them at the end.  It keeps
-    1F1B's O(P) activation stash and beats the chunked fill-drain
-    alternative at the same memory bound (M/C chunks × (C+P-1) fwd+bwd
-    ticks; e.g. P=4, M=16, C=4: 28 chunked ticks vs 22 here), while
-    unbounded fill-drain (O(M) stash) remains the throughput-optimal mode
-    at M+P-1 equivalent ticks."""
+    See ``one_f_one_b_phase_ticks`` — the first P-1 ticks are
+    forward-only and the last P-1 backward-only, so only the M steady
+    ticks pay a full fwd+bwd slot and the wall-clock bubble is the
+    reference ``TrainSchedule``'s (P-1)/(M+P-1)."""
     return num_micro + 2 * (num_stages - 1)
+
+
+def one_f_one_b_phase_ticks(num_micro, num_stages):
+    """Per-phase tick counts ``(warmup, steady, cooldown)`` of the
+    interleaved 1F1B schedule: ``(P-1, M, P-1)``.
+
+    The schedule's global tick grid is M + 2(P-1) ticks — stage *s*
+    forwards microbatch ``t - s`` and backwards ``t - 2(P-1) + s`` — but
+    no stage has live backward work before tick P-1 and none has live
+    forward (or loss) work from tick M+P-1 on.  Staging the scan as three
+    bodies (fwd-only / fwd+bwd / bwd-only) therefore drops only dead
+    compute: warmup ticks cost one forward, cooldown ticks one backward,
+    for a wall-clock of ``(P-1)·tf + M·(tf+tb) + (P-1)·tb =
+    (M+P-1)·(tf+tb)`` — a bubble fraction of ``(P-1)/(M+P-1)``, exactly
+    the reference's asynchronous 1F1B (``runtime/pipe/schedule.py:189``).
+    It keeps 1F1B's O(P) activation stash and strictly beats chunked
+    fill-drain at the same memory bound (M/C chunks × (C+P-1) full ticks;
+    e.g. P=4, M=16, C=4: 28 chunked full ticks vs 19 equivalent here)."""
+    return num_stages - 1, num_micro, num_stages - 1
 
 
 
@@ -155,8 +168,11 @@ def spmd_pipeline_1f1b(stage_fn, stacked_params, first_fn, first_params,
     """Interleaved 1F1B pipeline with hand-rolled per-tick backward.
 
     TPU-native rendering of the reference ``TrainSchedule``
-    (``runtime/pipe/schedule.py:189``): one ``lax.scan`` over
-    ``one_f_one_b_ticks(M, P)`` ticks inside ``shard_map`` over ``pp``.
+    (``runtime/pipe/schedule.py:189``): three ``lax.scan`` phases over one
+    global grid of ``one_f_one_b_ticks(M, P)`` ticks inside ``shard_map``
+    over ``pp`` — P-1 forward-only warmup ticks, M combined fwd+bwd steady
+    ticks, P-1 backward-only cooldown ticks (``one_f_one_b_phase_ticks``)
+    — matching the reference's (P-1)/(M+P-1) bubble.
     Like the reference's stage placement, the boundary layers live INSIDE
     the schedule — ``first_fn`` (embedding/pre chain) runs on stage 0 and
     ``last_fn`` (post chain + per-microbatch loss) on the last stage — so
@@ -224,19 +240,17 @@ def spmd_pipeline_1f1b(stage_fn, stacked_params, first_fn, first_params,
             return jax.tree.map(
                 lambda l: jnp.where(cond, l, jnp.zeros_like(l)), tree)
 
-        def tick(carry, t):
-            (y_state, dx_state, ring_act, ring_in, gbody, gfirst, glast,
-             loss_acc) = carry
-            # ---- forward unit ----
+        # NOTE control-flow discipline: every lax.cond predicate below
+        # depends on the tick counter t ONLY (globally uniform), never
+        # on the stage id — a sid-dependent branch containing the
+        # tp-sharded head/embedding diverged the pp groups' collective
+        # sequences and deadlocked the mesh.  sid-dependence is
+        # expressed with jnp.where masks on uniformly-executed compute.
+
+        def fwd_unit(y_state, ring_act, ring_in, t):
             recv = jax.tree.map(
                 lambda l: lax.ppermute(l, pp_axis, fwd_perm),
                 y_state) if n_stages > 1 else y_state
-            # NOTE control-flow discipline: every lax.cond predicate below
-            # depends on the tick counter t ONLY (globally uniform), never
-            # on the stage id — a sid-dependent branch containing the
-            # tp-sharded head/embedding diverged the pp groups' collective
-            # sequences and deadlocked the mesh.  sid-dependence is
-            # expressed with jnp.where masks on uniformly-executed compute.
             m_f = t - sid
             f_active = jnp.logical_and(m_f >= 0, m_f < M)
             in_m = at(inputs, jnp.clip(m_f, 0, M - 1))
@@ -248,31 +262,25 @@ def spmd_pipeline_1f1b(stage_fn, stacked_params, first_fn, first_params,
             y = mask(stage_fn(params_local, x_in), f_active)
             ring_act = put(ring_act, x_in, t % R)
             ring_in = put(ring_in, in_m, t % R)
-            # ---- loss + backward seed on the last stage ----
+            return y, ring_act, ring_in
+
+        def seed_unit(t, y):
+            # loss + backward seed on the last stage; steady ticks only
+            # (t in [P-1, M+P-2] ⇒ m_l in [0, M-1], always in-window)
             m_l = t - last_sid
             l_active = jnp.logical_and(m_l >= 0, m_l < M)
-            l_window = jnp.logical_and(t >= 0, t < M + last_sid + 1)
+            lab = at(labels, jnp.clip(m_l, 0, M - 1))
+            loss_m, lvjp = jax.vjp(
+                lambda lp, yy: last_fn(lp, yy, lab), last_p, y)
+            dlast, dy = lvjp(seed.astype(loss_m.dtype))
+            on_last = jnp.logical_and(sid == last_sid, l_active)
+            return jnp.where(on_last, loss_m.astype(jnp.float32), 0.0), \
+                mask(jax.tree.map(lambda g: g.astype(jnp.float32),
+                                  dlast), on_last), \
+                mask(dy, on_last)
 
-            def seed_branch():
-                lab = at(labels, jnp.clip(m_l, 0, M - 1))
-                loss_m, lvjp = jax.vjp(
-                    lambda lp, yy: last_fn(lp, yy, lab), last_p, y)
-                dlast, dy = lvjp(seed.astype(loss_m.dtype))
-                on_last = jnp.logical_and(sid == last_sid, l_active)
-                return jnp.where(on_last, loss_m.astype(jnp.float32), 0.0), \
-                    mask(jax.tree.map(lambda g: g.astype(jnp.float32),
-                                      dlast), on_last), \
-                    mask(dy, on_last)
-
-            def zero_branch():
-                return jnp.zeros((), jnp.float32), zeros_f32(last_p), \
-                    jax.tree.map(jnp.zeros_like, y)
-
-            loss_m, dlast_m, dy_seed = lax.cond(
-                l_window, seed_branch, zero_branch)
-            loss_acc = loss_acc + loss_m
-            glast = jax.tree.map(jnp.add, glast, dlast_m)
-            # ---- backward unit ----
+        def bwd_unit(dx_state, ring_act, ring_in, gbody, gfirst,
+                     dy_seed, y_ref, t):
             brecv = jax.tree.map(
                 lambda l: lax.ppermute(l, pp_axis, bwd_perm),
                 dx_state) if n_stages > 1 else dx_state
@@ -288,7 +296,7 @@ def spmd_pipeline_1f1b(stage_fn, stacked_params, first_fn, first_params,
             x_b = at(ring_act, slot)
             _, svjp = jax.vjp(stage_fn, params_local, x_b)
             dp, dx = svjp(jax.tree.map(
-                lambda l, yl: l.astype(yl.dtype), dy_in, y))
+                lambda l, yl: l.astype(yl.dtype), dy_in, y_ref))
             gbody = jax.tree.map(
                 lambda g, d: g + jnp.where(b_active,
                                            d.astype(jnp.float32), 0.0),
@@ -312,14 +320,52 @@ def spmd_pipeline_1f1b(stage_fn, stacked_params, first_fn, first_params,
             dfirst_m = lax.cond(b0_window, first_b_branch,
                                 lambda: zeros_f32(first_p))
             gfirst = jax.tree.map(jnp.add, gfirst, dfirst_m)
+            return dx, gbody, gfirst
+
+        # Three scan phases over one global tick grid (see
+        # one_f_one_b_phase_ticks): ticks [0, P-1) have no live backward
+        # anywhere and ticks [M+P-1, T) no live forward/loss anywhere, so
+        # the warmup body is fwd-only (costs tf) and the cooldown body
+        # bwd-only (costs tb) — the wall-clock bubble is (P-1)/(M+P-1),
+        # the reference TrainSchedule's.
+        def warmup_tick(carry, t):
+            (y_state, dx_state, ring_act, ring_in, gbody, gfirst, glast,
+             loss_acc) = carry
+            y, ring_act, ring_in = fwd_unit(y_state, ring_act, ring_in, t)
+            return (y, dx_state, ring_act, ring_in, gbody, gfirst, glast,
+                    loss_acc), None
+
+        def steady_tick(carry, t):
+            (y_state, dx_state, ring_act, ring_in, gbody, gfirst, glast,
+             loss_acc) = carry
+            y, ring_act, ring_in = fwd_unit(y_state, ring_act, ring_in, t)
+            loss_m, dlast_m, dy_seed = seed_unit(t, y)
+            loss_acc = loss_acc + loss_m
+            glast = jax.tree.map(jnp.add, glast, dlast_m)
+            dx, gbody, gfirst = bwd_unit(dx_state, ring_act, ring_in,
+                                         gbody, gfirst, dy_seed, y, t)
             return (y, dx, ring_act, ring_in, gbody, gfirst, glast,
                     loss_acc), None
 
-        carry0 = (act0, jax.tree.map(jnp.zeros_like, act0), ring_act0,
-                  ring_in0, gbody0, gfirst0, glast0,
-                  jnp.zeros((), jnp.float32))
-        (_, _, _, _, gbody, gfirst, glast, loss_acc), _ = lax.scan(
-            tick, carry0, jnp.arange(T))
+        def cooldown_tick(carry, t):
+            (y_state, dx_state, ring_act, ring_in, gbody, gfirst, glast,
+             loss_acc) = carry
+            dy_zero = jax.tree.map(jnp.zeros_like, y_state)
+            dx, gbody, gfirst = bwd_unit(dx_state, ring_act, ring_in,
+                                         gbody, gfirst, dy_zero, y_state, t)
+            return (y_state, dx, ring_act, ring_in, gbody, gfirst, glast,
+                    loss_acc), None
+
+        carry = (act0, jax.tree.map(jnp.zeros_like, act0), ring_act0,
+                 ring_in0, gbody0, gfirst0, glast0,
+                 jnp.zeros((), jnp.float32))
+        warm, steady, cool = one_f_one_b_phase_ticks(M, n_stages)
+        carry, _ = lax.scan(warmup_tick, carry, jnp.arange(warm))
+        carry, _ = lax.scan(steady_tick, carry,
+                            jnp.arange(warm, warm + steady))
+        carry, _ = lax.scan(cooldown_tick, carry,
+                            jnp.arange(warm + steady, T))
+        (_, _, _, _, gbody, gfirst, glast, loss_acc) = carry
         # loss/last-grads live on the last stage, first-grads on stage 0;
         # psum broadcasts each to every pp shard
         if n_stages > 1:

@@ -103,17 +103,24 @@ class PipelineEngine(DeepSpeedEngine):
         self._init_fn = None
         self._raw_apply = None   # pipeline path doesn't use the base apply
 
-    def _layer_params_and_apply(self, layer, rng, x_abs):
+    def _layer_params_and_apply(self, layer, rng, x_abs, abstract=False):
         """Init one layer against the incoming abstract activation.
 
         Every returned apply has the uniform signature
         ``apply(params, x, train=True)``; the flag is forwarded only to
         modules whose ``__call__`` declares it (MoE gates switch their
-        capacity/noise regime on it, like the dense Transformer)."""
+        capacity/noise regime on it, like the dense Transformer).
+        ``abstract=True`` shape-evaluates the init instead of running it —
+        the checkpoint-restore path needs only structure/shapes and must
+        not materialize a throwaway random copy of the model."""
         import inspect
         import flax.linen as nn
         if isinstance(layer, nn.Module):
-            params = layer.init(rng, _zeros_like_abs(x_abs))
+            if abstract:
+                params = jax.eval_shape(
+                    lambda r: layer.init(r, _zeros_like_abs(x_abs)), rng)
+            else:
+                params = layer.init(rng, _zeros_like_abs(x_abs))
             takes_train = "train" in inspect.signature(
                 type(layer).__call__).parameters
             if takes_train:
@@ -126,7 +133,7 @@ class PipelineEngine(DeepSpeedEngine):
         y_abs = jax.eval_shape(layer, x_abs)
         return None, (lambda p, x, train=True: layer(x)), y_abs
 
-    def _build_pipeline(self, example_micro):
+    def _build_pipeline(self, example_micro, abstract=False):
         """Initialize all layers, split pre/body/post, stack body.
 
         ``TiedLayerSpec`` layers sharing a key share parameters (reference
@@ -160,7 +167,8 @@ class PipelineEngine(DeepSpeedEngine):
             if tied_key is not None:
                 tied_first[tied_key] = i
             rng, sub = jax.random.split(rng)
-            params, apply, x_abs = self._layer_params_and_apply(layer, sub, x_abs)
+            params, apply, x_abs = self._layer_params_and_apply(
+                layer, sub, x_abs, abstract=abstract)
             inits.append(params)
             applies.append(apply)
             structs.append(jax.tree.structure(params)
@@ -205,7 +213,13 @@ class PipelineEngine(DeepSpeedEngine):
         self._post = [outer_entry(i) for i in range(last + 1, len(layers))]
         self._body_apply = applies[first]
         body_params = [inits[i] for i in range(first, last + 1)]
-        self._body_stacked = stack_stage_params(body_params, self.topology.pp)
+        if abstract:
+            self._body_stacked = jax.eval_shape(
+                lambda ps: stack_stage_params(ps, self.topology.pp),
+                body_params)
+        else:
+            self._body_stacked = stack_stage_params(body_params,
+                                                    self.topology.pp)
         log_dist(f"pipeline split: {first} pre / {body_count} body "
                  f"({self.topology.pp} stages × {body_count // self.topology.pp}) "
                  f"/ {len(layers) - last - 1} post layers", ranks=[0])
@@ -247,13 +261,49 @@ class PipelineEngine(DeepSpeedEngine):
                      "post": outer_plan.opt_specs["post"]}
         return ZeroShardingPlan(param_specs, grad_specs, opt_specs, mesh)
 
+    def _build_plan(self, abstract_params):
+        """Base-engine hook override: the fresh-engine checkpoint-restore
+        paths (``load_checkpoint`` → ``_init_params_from`` /
+        ``_metadata_restore_targets``) build the plan from loaded shapes —
+        a pipe-structured tree must get the pipe plan (pp-lifted body
+        specs), not the flat one."""
+        if (isinstance(abstract_params, dict)
+                and set(abstract_params) == {"pre", "body", "post"}):
+            self._plan = self._build_pipe_plan(abstract_params)
+            self._abstract_params = abstract_params
+        else:
+            super()._build_plan(abstract_params)
+
     def _lazy_init_pipe(self, batch):
-        if self._params is not None:
+        built = getattr(self, "_body_apply", None) is not None
+        if self._params is not None and built:
             return
         micro = jax.tree.map(lambda x: x[0], batch)
-        self._build_pipeline(micro)
+        loaded = self._params
+        # with params already restored, only structure/shapes are needed —
+        # don't materialize a throwaway random init of the whole model
+        self._build_pipeline(micro, abstract=loaded is not None)
         raw = self._assemble_params()
         abstract = jax.eval_shape(lambda t: t, raw)
+        if loaded is not None:
+            # params were restored by load_checkpoint into a fresh engine
+            # (which already built the pipe plan + optimizer state from the
+            # loaded shapes via _build_plan above); only the module
+            # structure — the pre/body/post split and layer applies — was
+            # missing.  Keep the loaded params; the just-initialized layer
+            # values are discarded.
+            if jax.tree.structure(loaded) != jax.tree.structure(abstract):
+                raise ValueError(
+                    "checkpoint params do not match the pipeline module "
+                    "structure (different layer split or layer count)")
+            mismatch = [f"{a.shape} vs {b.shape}" for a, b in zip(
+                jax.tree.leaves(loaded), jax.tree.leaves(abstract))
+                if tuple(a.shape) != tuple(b.shape)]
+            if mismatch:
+                raise ValueError(
+                    f"checkpoint param shapes do not match the pipeline "
+                    f"module: {mismatch[:3]}")
+            return
         self._plan = self._build_pipe_plan(abstract)
         self._abstract_params = abstract
         put = jax.jit(lambda t: jax.tree.map(

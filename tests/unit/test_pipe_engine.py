@@ -292,20 +292,64 @@ def test_pipeline_1f1b_tied_and_postln_layout():
 
 
 def test_pipeline_1f1b_tick_count_and_bubble():
-    """Schedule math: M + 2(P-1) interleaved ticks, each one fwd + one bwd
-    unit, vs the reference asynchronous 1F1B's (P-1)/(M+P-1) bubble — the
-    SPMD lockstep pays the backward wavefront's P-1 extra ticks at the end
-    (documented in ``one_f_one_b_ticks``), and still strictly beats chunked
-    accumulation at the same O(P) memory bound."""
-    from deepspeed_tpu.parallel.pipeline import one_f_one_b_ticks
+    """Schedule math: the three-phase staging (P-1 fwd-only warmup ticks,
+    M combined steady ticks, P-1 bwd-only cooldown ticks) makes the
+    wall-clock bubble exactly the reference asynchronous 1F1B's
+    (P-1)/(M+P-1) (``runtime/pipe/schedule.py:189``): warmup ticks cost tf
+    and cooldown ticks tb, so total = (M+P-1)(tf+tb) — the fill-drain
+    equivalent-tick count — at an O(P) stash, strictly beating chunked
+    accumulation at the same memory bound."""
+    from deepspeed_tpu.parallel.pipeline import (one_f_one_b_phase_ticks,
+                                                 one_f_one_b_ticks)
     M, PP, C = 16, 4, 4
-    assert one_f_one_b_ticks(M, PP) == 22
-    chunked_ticks = (M // C) * (C + PP - 1)          # 28
-    fill_drain_ticks = M + PP - 1                    # 19 (O(M) stash)
-    assert one_f_one_b_ticks(M, PP) < chunked_ticks
-    assert one_f_one_b_ticks(M, PP) > fill_drain_ticks
-    bubble = (one_f_one_b_ticks(M, PP) - M) / one_f_one_b_ticks(M, PP)
-    assert abs(bubble - 2 * (PP - 1) / (M + 2 * (PP - 1))) < 1e-12
+    warm, steady, cool = one_f_one_b_phase_ticks(M, PP)
+    assert (warm, steady, cool) == (PP - 1, M, PP - 1)
+    assert one_f_one_b_ticks(M, PP) == warm + steady + cool == 22
+    # wall-clock in (tf+tb) units: warmup/cooldown each cost half a tick
+    equivalent_full_ticks = steady + (warm + cool) / 2          # 19
+    fill_drain_ticks = M + PP - 1                               # 19 (O(M) stash)
+    chunked_ticks = (M // C) * (C + PP - 1)                     # 28
+    assert equivalent_full_ticks == fill_drain_ticks
+    assert equivalent_full_ticks < chunked_ticks
+    bubble = (equivalent_full_ticks - M) / equivalent_full_ticks
+    assert abs(bubble - (PP - 1) / (M + PP - 1)) < 1e-12
+
+
+@pytest.mark.parametrize("schedule", ["fill_drain", "1f1b"])
+def test_pipeline_checkpoint_resume_fresh_engine(schedule, tmp_path):
+    """A checkpoint saved by a PipelineEngine loads into a FRESH
+    PipelineEngine (no prior train step) and training continues: the
+    fresh-load path must build the pipe plan (pp-lifted body specs) from
+    the loaded shapes and rebuild the pre/body/post module structure on
+    the first train_batch without clobbering the restored params."""
+    def make(sched):
+        module = transformer_pipe(tiny_cfg())
+        engine, *_ = deepspeed_tpu.initialize(
+            model=module,
+            config={"train_micro_batch_size_per_gpu": 4,
+                    "gradient_accumulation_steps": 4,
+                    "optimizer": {"type": "Adam", "params": {"lr": 5e-3}},
+                    "pipeline": {"stages": 2, "schedule": sched}})
+        return engine
+
+    batch = pipe_batch(M=4, seed=7)
+    e = make(schedule)
+    for _ in range(3):
+        float(jax.device_get(e.train_batch(batch=batch)))
+    e.save_checkpoint(str(tmp_path))
+    saved_leaf = np.asarray(jax.device_get(jax.tree.leaves(e._params)[0]))
+
+    e2 = make(schedule)
+    e2.load_checkpoint(str(tmp_path))
+    assert e2.global_steps == e.global_steps
+    loaded_leaf = np.asarray(jax.device_get(jax.tree.leaves(e2._params)[0]))
+    np.testing.assert_array_equal(saved_leaf, loaded_leaf)
+    # the first train_batch rebuilds the module structure — it must NOT
+    # clobber the restored params/opt: the resumed step's loss must match
+    # the original engine continuing from the same state
+    l_resume = float(jax.device_get(e2.train_batch(batch=batch)))
+    l_orig = float(jax.device_get(e.train_batch(batch=batch)))
+    np.testing.assert_allclose(l_resume, l_orig, rtol=1e-5)
 
 
 def test_pipeline_1f1b_rejects_chunking():

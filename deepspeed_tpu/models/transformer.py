@@ -72,6 +72,9 @@ class TransformerConfig:
     moe_eval_capacity_factor: float = 1.0
     moe_ep_size: int = 1
     moe_aux_coef: float = 0.01
+    # Megatron-style MoE experts carry per-expert biases (dense_h_to_4h.bias
+    # / dense_4h_to_h.bias) — needed for exact checkpoint parity
+    moe_expert_bias: bool = False
     lm_head_bias: bool = False               # gptj
     # opt-350m: embeddings live in a smaller space with project_in /
     # project_out linears around the trunk (HF word_embed_proj_dim)
@@ -537,6 +540,7 @@ def _block_mlp(cfg, layer_idx, h, train=True):
                       capacity_factor=cfg.moe_capacity_factor,
                       eval_capacity_factor=cfg.moe_eval_capacity_factor,
                       ffn_hidden_size=cfg.ffn_size,
+                      expert_bias=cfg.moe_expert_bias,
                       dtype=cfg.jnp_dtype, name="moe_mlp")(h, train=train)
     return out.astype(cfg.jnp_dtype), aux
 

@@ -630,7 +630,9 @@ class MegatronGPTPolicy(HFPolicy):
         out.update(self.norm(sd, "transformer.final_layernorm", "final_norm"))
         return out
 
-    def layer_params(self, sd, i, cfg):
+    def _attn_and_norms(self, sd, i, cfg):
+        """The attention + layernorm portion of one Megatron layer —
+        shared with the MoE subclass, whose MLP mapping differs."""
         p = f"transformer.layers.{i}"
         H, D = cfg.num_heads, cfg.head_dim
         w = sd[f"{p}.attention.query_key_value.weight"]
@@ -646,12 +648,100 @@ class MegatronGPTPolicy(HFPolicy):
         out.update(self.norm(sd, f"{p}.input_layernorm", "input_norm"))
         out.update(self.norm(sd, f"{p}.post_attention_layernorm",
                              "post_attn_norm"))
+        return out
+
+    def layer_params(self, sd, i, cfg):
+        p = f"transformer.layers.{i}"
+        out = self._attn_and_norms(sd, i, cfg)
         out["mlp/up_proj/kernel"] = linear_kernel(
             sd[f"{p}.mlp.dense_h_to_4h.weight"])
         out["mlp/up_proj/bias"] = _np(sd[f"{p}.mlp.dense_h_to_4h.bias"])
         out["mlp/down_proj/kernel"] = linear_kernel(
             sd[f"{p}.mlp.dense_4h_to_h.weight"])
         out["mlp/down_proj/bias"] = _np(sd[f"{p}.mlp.dense_4h_to_h.bias"])
+        return out
+
+
+class MegatronGPTMoEPolicy(MegatronGPTPolicy):
+    """Megatron-DeepSpeed MoE-GPT checkpoints (reference
+    ``containers/megatron_gpt_moe.py`` ``MegatronMoELayerPolicy``, standard
+    ``moe_type``): a Megatron GPT trunk where every ``expert_interval``-th
+    layer's MLP is a DeepSpeed-MoE block — per-expert 2-layer MLPs under
+    ``mlp.deepspeed_moe.experts.deepspeed_experts.{e}.*`` plus a top-k
+    gate ``mlp.deepspeed_moe.gate.wg.weight``.  Maps onto the MoE trunk of
+    ``models/transformer.py`` (experts stacked on a leading E dim, sharded
+    over the ``ep`` mesh axis; gate kernel transposed to [M, E]).
+
+    The reference's ``moe_type='residual'`` (expert outputs blended with a
+    dense MLP through a learned coefficient) is not mapped: our residual
+    MoE uses a single-Dense blend, so the checkpoint shapes differ."""
+
+    model_types = ("megatron-gpt-moe",)
+
+    @staticmethod
+    def detect_moe(sd):
+        """(num_experts, expert_interval) from a merged/normalized state
+        dict; (0, 0) when no MoE layers exist."""
+        import re as _re
+        moe_layers, experts = set(), set()
+        for k in sd:
+            m = _re.match(r"transformer\.layers\.(\d+)\.mlp\.deepspeed_moe\."
+                          r"experts\.deepspeed_experts\.(\d+)\.", k)
+            if m:
+                moe_layers.add(int(m.group(1)))
+                experts.add(int(m.group(2)))
+        if not moe_layers:
+            return 0, 0
+        # residual moe_type stores the dense blend branch as mlp.mlp.* and
+        # the blend weights as mlp.coefficient.* (reference MoE layer's
+        # use_residual members)
+        if any(k.startswith("transformer.layers.")
+               and (".mlp.coefficient." in k or ".mlp.mlp." in k)
+               for k in sd):
+            raise NotImplementedError(
+                "megatron moe_type='residual' checkpoints are not supported "
+                "(see MegatronGPTMoEPolicy docstring)")
+        first = min(moe_layers)
+        interval = first + 1
+        expect = set(range(first, 1 + max(moe_layers), interval))
+        if moe_layers != expect:
+            raise ValueError(
+                f"MoE layers {sorted(moe_layers)} are not a fixed "
+                f"expert-interval pattern")
+        return len(experts), interval
+
+    def build_config(self, hf, **over):
+        get = lambda n, d=None: getattr(hf, n, d)
+        base = dict(
+            moe_num_experts=get("num_experts", 0),
+            moe_every=get("expert_interval", 2),
+            # megatron-deepspeed's arg name is 'topk'
+            moe_top_k=get("moe_top_k", None) or get("topk", None) or 1,
+            moe_expert_bias=True,
+            # mixed dense/MoE blocks are heterogeneous — no layer scan
+            scan_layers=False,
+        )
+        base.update(over)
+        return super().build_config(hf, **base)
+
+    def layer_params(self, sd, i, cfg):
+        from deepspeed_tpu.models.transformer import _is_moe_layer
+        if not _is_moe_layer(cfg, i):
+            return super().layer_params(sd, i, cfg)
+        p = f"transformer.layers.{i}.mlp.deepspeed_moe"
+        E = cfg.moe_num_experts
+        ex = lambda e, n: sd[f"{p}.experts.deepspeed_experts.{e}.{n}"]
+        out = self._attn_and_norms(sd, i, cfg)
+        # gate wg: torch [E, M] → flax [M, E]
+        out["moe_mlp/gate_kernel"] = linear_kernel(sd[f"{p}.gate.wg.weight"])
+        out["moe_mlp/ExpertsMLP_0/experts_wi"] = np.stack(
+            [linear_kernel(ex(e, "dense_h_to_4h.weight")) for e in range(E)])
+        out["moe_mlp/ExpertsMLP_0/experts_bi"] = np.stack(
+            [_np(ex(e, "dense_h_to_4h.bias")) for e in range(E)])
+        out["moe_mlp/ExpertsMLP_0/experts_wo"] = np.stack(
+            [linear_kernel(ex(e, "dense_4h_to_h.weight")) for e in range(E)])
+        out["moe_mlp/ExpertsMLP_0/experts_bo"] = np.stack(
+            [_np(ex(e, "dense_4h_to_h.bias")) for e in range(E)])
         return out
 
 

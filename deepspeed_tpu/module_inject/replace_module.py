@@ -137,7 +137,14 @@ def load_megatron_model(checkpoint, num_heads=None, megatron_v2=True,
     pos_key = emb_key.replace("word", "position")
     layer_ids = {int(m.group(1)) for k in sd
                  if (m := re.match(r"transformer\.layers\.(\d+)\.", k))}
-    h4h = sd[f"transformer.layers.0.mlp.dense_h_to_4h.weight"]
+    # MoE-GPT checkpoints (Megatron-DeepSpeed): per-expert MLPs under
+    # mlp.deepspeed_moe.* on every expert_interval-th layer
+    from deepspeed_tpu.module_inject.containers import MegatronGPTMoEPolicy
+    num_experts, expert_interval = MegatronGPTMoEPolicy.detect_moe(sd)
+    dense_key = "transformer.layers.0.mlp.dense_h_to_4h.weight"
+    h4h = sd[dense_key] if dense_key in sd else \
+        sd["transformer.layers.0.mlp.deepspeed_moe.experts."
+           "deepspeed_experts.0.dense_h_to_4h.weight"]
 
     class _Args:                              # megatron arg namespace
         vocab_size = np.asarray(sd[emb_key]).shape[0]
@@ -147,10 +154,12 @@ def load_megatron_model(checkpoint, num_heads=None, megatron_v2=True,
         ffn_hidden_size = np.asarray(h4h).shape[0]
         max_position_embeddings = np.asarray(sd[pos_key]).shape[0]
 
+    _Args.num_experts = num_experts
+    _Args.expert_interval = expert_interval
     if num_heads is None:
         raise ValueError("num_heads is not recoverable from a megatron "
                          "state dict — pass num_heads=")
-    policy = MegatronGPTPolicy()
+    policy = MegatronGPTMoEPolicy() if num_experts else MegatronGPTPolicy()
     policy.megatron_v2 = megatron_v2
     cfg = policy.build_config(_Args(), **config_overrides)
     flat = policy.convert(sd, cfg)

@@ -27,6 +27,7 @@ class ExpertsMLP(nn.Module):
     ffn_hidden_size: int
     activation: Callable = nn.gelu
     dtype: Any = jnp.bfloat16
+    use_bias: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -37,8 +38,18 @@ class ExpertsMLP(nn.Module):
         wo = self.param("experts_wo", nn.initializers.lecun_normal(),
                         (E, F, M), jnp.float32)
         h = jnp.einsum("ecm,emf->ecf", x, wi.astype(x.dtype))
+        if self.use_bias:
+            # Megatron-style experts carry per-expert biases
+            bi = self.param("experts_bi", nn.initializers.zeros, (E, F),
+                            jnp.float32)
+            h = h + bi[:, None, :].astype(x.dtype)
         h = self.activation(h)
-        return jnp.einsum("ecf,efm->ecm", h, wo.astype(x.dtype))
+        y = jnp.einsum("ecf,efm->ecm", h, wo.astype(x.dtype))
+        if self.use_bias:
+            bo = self.param("experts_bo", nn.initializers.zeros, (E, M),
+                            jnp.float32)
+            y = y + bo[:, None, :].astype(x.dtype)
+        return y
 
 
 class MoE(nn.Module):
@@ -60,6 +71,7 @@ class MoE(nn.Module):
     ffn_hidden_size: Optional[int] = None
     expert: Optional[nn.Module] = None
     dtype: Any = jnp.bfloat16
+    expert_bias: bool = False
 
     @nn.compact
     def __call__(self, x, train=True):
@@ -78,7 +90,8 @@ class MoE(nn.Module):
         aux_loss, combine, dispatch, exp_counts = gate(logits, train, rng)
 
         experts = self.expert or ExpertsMLP(
-            self.num_experts, M, self.ffn_hidden_size or 4 * M, dtype=self.dtype)
+            self.num_experts, M, self.ffn_hidden_size or 4 * M,
+            dtype=self.dtype, use_bias=self.expert_bias)
         y = moe_dispatch_combine(tokens, combine, dispatch, experts)
 
         if self.use_residual:

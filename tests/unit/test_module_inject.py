@@ -240,6 +240,109 @@ def test_megatron_checkpoint_loads_with_tp_merge(tmp_path):
     np.testing.assert_allclose(got, hf_logits(hf, ids), atol=1e-4, rtol=1e-4)
 
 
+def test_megatron_moe_checkpoint_loads():
+    """Megatron-DeepSpeed MoE-GPT container (reference
+    ``containers/megatron_gpt_moe.py`` MegatronMoELayerPolicy, standard
+    moe_type): per-expert MLPs under mlp.deepspeed_moe.experts.
+    deepspeed_experts.{e}.* plus the gate wg — auto-detected by
+    load_megatron_model, stacked onto the MoE trunk's [E, ...] expert
+    params with the gate transposed to [M, E]."""
+    from deepspeed_tpu.module_inject import load_megatron_model
+
+    rng = np.random.default_rng(11)
+    M, F, H, L, E, V, S = 32, 64, 4, 4, 4, 97, 32
+    r = lambda *s: rng.standard_normal(s).astype(np.float32) * 0.05
+    sd = {"word_embeddings.weight": r(V, M),
+          "position_embeddings.weight": r(S, M),
+          "transformer.final_layernorm.weight": np.ones(M, np.float32),
+          "transformer.final_layernorm.bias": np.zeros(M, np.float32)}
+    for i in range(L):
+        p = f"transformer.layers.{i}"
+        sd[f"{p}.input_layernorm.weight"] = np.ones(M, np.float32)
+        sd[f"{p}.input_layernorm.bias"] = np.zeros(M, np.float32)
+        sd[f"{p}.attention.query_key_value.weight"] = r(3 * M, M)
+        sd[f"{p}.attention.query_key_value.bias"] = r(3 * M)
+        sd[f"{p}.attention.dense.weight"] = r(M, M)
+        sd[f"{p}.attention.dense.bias"] = r(M)
+        sd[f"{p}.post_attention_layernorm.weight"] = np.ones(M, np.float32)
+        sd[f"{p}.post_attention_layernorm.bias"] = np.zeros(M, np.float32)
+        if i % 2 == 1:          # expert_interval=2: layers 1, 3 are MoE
+            moe = f"{p}.mlp.deepspeed_moe"
+            sd[f"{moe}.gate.wg.weight"] = r(E, M)
+            for e in range(E):
+                ep = f"{moe}.experts.deepspeed_experts.{e}"
+                sd[f"{ep}.dense_h_to_4h.weight"] = r(F, M)
+                sd[f"{ep}.dense_h_to_4h.bias"] = r(F)
+                sd[f"{ep}.dense_4h_to_h.weight"] = r(M, F)
+                sd[f"{ep}.dense_4h_to_h.bias"] = r(M)
+        else:
+            sd[f"{p}.mlp.dense_h_to_4h.weight"] = r(F, M)
+            sd[f"{p}.mlp.dense_h_to_4h.bias"] = r(F)
+            sd[f"{p}.mlp.dense_4h_to_h.weight"] = r(M, F)
+            sd[f"{p}.mlp.dense_4h_to_h.bias"] = r(M)
+
+    model, params = load_megatron_model(dict(sd), num_heads=H,
+                                        dtype="float32",
+                                        use_flash_attention=False)
+    cfg = model.config
+    assert cfg.moe_num_experts == E and cfg.moe_every == 2
+    assert cfg.moe_expert_bias and not cfg.scan_layers
+
+    # mapping exactness: gate transposed, experts stacked in index order
+    moe1 = params["params"]["layers_1"]["moe_mlp"]
+    np.testing.assert_array_equal(
+        np.asarray(moe1["gate_kernel"]),
+        sd["transformer.layers.1.mlp.deepspeed_moe.gate.wg.weight"].T)
+    exp = moe1["ExpertsMLP_0"]
+    for e in range(E):
+        ep = f"transformer.layers.1.mlp.deepspeed_moe.experts." \
+             f"deepspeed_experts.{e}"
+        np.testing.assert_array_equal(
+            np.asarray(exp["experts_wi"])[e], sd[f"{ep}.dense_h_to_4h.weight"].T)
+        np.testing.assert_array_equal(
+            np.asarray(exp["experts_bi"])[e], sd[f"{ep}.dense_h_to_4h.bias"])
+        np.testing.assert_array_equal(
+            np.asarray(exp["experts_wo"])[e], sd[f"{ep}.dense_4h_to_h.weight"].T)
+        np.testing.assert_array_equal(
+            np.asarray(exp["experts_bo"])[e], sd[f"{ep}.dense_4h_to_h.bias"])
+
+    ids = np.random.default_rng(7).integers(0, V, (2, 16)).astype(np.int32)
+    logits = np.asarray(jax.jit(
+        lambda p, i: model.apply(p, i, method=type(model).logits))(params, ids))
+    assert np.isfinite(logits).all()
+
+    # a dense-layer-only checkpoint still routes to the plain GPT policy
+    dense_sd = {k: v for k, v in sd.items() if ".deepspeed_moe." not in k}
+    for i in (1, 3):
+        p = f"transformer.layers.{i}"
+        dense_sd[f"{p}.mlp.dense_h_to_4h.weight"] = r(F, M)
+        dense_sd[f"{p}.mlp.dense_h_to_4h.bias"] = r(F)
+        dense_sd[f"{p}.mlp.dense_4h_to_h.weight"] = r(M, F)
+        dense_sd[f"{p}.mlp.dense_4h_to_h.bias"] = r(M)
+    model2, _ = load_megatron_model(dense_sd, num_heads=H, dtype="float32",
+                                    use_flash_attention=False)
+    assert model2.config.moe_num_experts == 0
+
+    # residual moe_type (dense blend branch mlp.mlp.* + mlp.coefficient.*)
+    # must be rejected loudly, not silently dropped
+    res_sd = dict(sd)
+    res_sd["transformer.layers.1.mlp.coefficient.weight"] = r(2, M)
+    with pytest.raises(NotImplementedError, match="residual"):
+        load_megatron_model(res_sd, num_heads=H)
+
+    # megatron-deepspeed arg name for top-k is 'topk'
+    from deepspeed_tpu.module_inject.containers import MegatronGPTMoEPolicy
+
+    class _Args:
+        vocab_size, hidden_size, num_layers = V, M, L
+        num_attention_heads, ffn_hidden_size = H, F
+        max_position_embeddings = S
+        num_experts, expert_interval, topk = E, 2, 2
+
+    cfg_topk = MegatronGPTMoEPolicy().build_config(_Args())
+    assert cfg_topk.moe_top_k == 2
+
+
 def test_clip_text_encoder_parity():
     """CLIP text tower (reference ``containers/clip.py``): causal pre-LN
     quick-gelu encoder; our hidden_states must match HF last_hidden_state."""

@@ -29,8 +29,9 @@ from jax.experimental.pallas import tpu as pltpu
 import os as _os
 
 # tuned on v5e at seq 2048/head_dim 64: large kv blocks amortize the
-# VPU-bound online-softmax bookkeeping (see bench sweep in commit message)
-DEFAULT_BLOCK_Q = int(_os.environ.get("DSTPU_FLASH_BLOCK_Q", "256"))
+# VPU-bound online-softmax bookkeeping; q=512 beats 256 and 1024 on the
+# OPT-1.3B train workload (larger bwd blocks overflow scoped vmem)
+DEFAULT_BLOCK_Q = int(_os.environ.get("DSTPU_FLASH_BLOCK_Q", "512"))
 DEFAULT_BLOCK_K = int(_os.environ.get("DSTPU_FLASH_BLOCK_K", "2048"))
 DEFAULT_BLOCK_Q_BWD = int(_os.environ.get("DSTPU_FLASH_BLOCK_Q_BWD", "1024"))
 DEFAULT_BLOCK_K_BWD = int(_os.environ.get("DSTPU_FLASH_BLOCK_K_BWD", "1024"))

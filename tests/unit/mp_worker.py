@@ -75,15 +75,30 @@ else:
         "zero_optimization": {"stage": 2},
         "seed": 0,
     }
-    if variant == "sp":
-        # ring attention over the FULL device set (sp=8, dp=1): edp is
-        # outer to sp in the mesh axis order, so only a full-width ring
-        # actually spans both processes' devices — the KV-rotation
-        # ppermutes then cross the process boundary (context parallelism
-        # at DCN tier)
+    if variant in ("sp", "ulysses"):
+        # sequence parallelism over the FULL device set (sp=8, dp=1): edp
+        # is outer to sp in the mesh axis order, so only a full-width sp
+        # axis actually spans both processes' devices.  "sp" = ring
+        # attention (KV-rotation ppermutes cross the process boundary);
+        # "ulysses" = all-to-all head scatter/gather crossing it (the
+        # DeepSpeed-Ulysses exchange at DCN tier)
         import dataclasses
-        cfg = dataclasses.replace(cfg, sequence_parallel_impl="ring")
+        impl = "ring" if variant == "sp" else "ulysses"
+        # ulysses scatters heads over sp: needs num_heads % sp == 0
+        heads = 4 if variant == "sp" else 8
+        cfg = dataclasses.replace(cfg, sequence_parallel_impl=impl,
+                                  num_heads=heads)
         config["sequence_parallel"] = {"sp_size": 8}
+    elif variant == "moe":
+        # expert parallelism over the FULL device set (ep=8, edp=1): the
+        # MoE dispatch/combine all_to_alls cross the process boundary —
+        # the reference's multi-node expert placement
+        # (moe/sharded_moe.py all_to_all over the expert group)
+        import dataclasses
+        cfg = dataclasses.replace(cfg, scan_layers=False,
+                                  moe_num_experts=8, moe_ep_size=8,
+                                  moe_every=2, moe_capacity_factor=2.0)
+        config["moe"] = {"ep_size": 8}
     engine, *_ = deepspeed_tpu.initialize(
         model=Transformer(cfg),
         config=config)

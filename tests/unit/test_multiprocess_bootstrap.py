@@ -163,3 +163,22 @@ def test_ring_attention_across_processes(tmp_path):
     framework's SP superset, SURVEY §2.3).  Losses must match the
     single-process 8-device run."""
     _launch_and_compare(tmp_path, variant="sp")
+
+
+@pytest.mark.slow
+def test_ulysses_attention_across_processes(tmp_path):
+    """DeepSpeed-Ulysses sequence parallelism with sp=8 spanning both
+    processes: the head-scatter/gather all-to-alls cross the process
+    boundary (reference deepspeed-ulysses maps this exchange onto the
+    inter-node fabric).  Losses must match the single-process 8-device
+    run."""
+    _launch_and_compare(tmp_path, variant="ulysses")
+
+
+@pytest.mark.slow
+def test_moe_expert_parallel_across_processes(tmp_path):
+    """Expert parallelism with ep=8 spanning both processes: the MoE
+    dispatch/combine all-to-alls cross the process boundary — multi-node
+    expert placement (reference ``moe/sharded_moe.py`` all_to_all over the
+    expert group).  Losses must match the single-process 8-device run."""
+    _launch_and_compare(tmp_path, variant="moe")

@@ -20,6 +20,12 @@ def main():
     args = ap.parse_args()
 
     import numpy as np
+
+# a sitecustomize may pin a hardware platform before this script runs; the
+# live jax config must be updated before first device use (env is too late)
+if os.environ.get("DSTPU_ACCELERATOR") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
     import deepspeed_tpu
     from transformers import AutoTokenizer
 

@@ -87,6 +87,8 @@ class ZeroConfig(DeepSpeedConfigModel):
     stage3_prefetch_bucket_size: int = 50_000_000
     stage3_param_persistence_threshold: int = 100_000
     stage3_gather_16bit_weights_on_model_save: bool = False
+    # hierarchical secondary partition (later reference versions' ZeRO++):
+    # on TPU the hierarchical layout IS the mesh — use mics_shard_size
     zero_hpz_partition_size: int = 1
     mics_shard_size: int = -1        # MiCS: shard group size (reference mics.py)
     mics_hierarchical_params_gather: bool = False
@@ -306,6 +308,14 @@ class DeepSpeedConfig:
         self.fp16 = FP16Config(**pd.get(C.FP16, {}))
         self.bf16 = BF16Config(**pd.get(C.BF16, pd.get("bfloat16", {})))
         self.zero_config = ZeroConfig(**pd.get(C.ZERO_OPTIMIZATION, {}))
+        if self.zero_config.zero_hpz_partition_size not in (0, 1):
+            # don't silently ignore a memory-affecting knob: on TPU the
+            # hierarchical secondary partition is expressed as a mesh
+            # layout, not a gather-time cache
+            raise ValueError(
+                "zero_hpz_partition_size is not supported on TPU — use "
+                "zero_optimization.mics_shard_size (hierarchical sharding "
+                "as mdp×edp mesh axes) instead")
         self.optimizer = OptimizerConfig(**pd.get(C.OPTIMIZER, {})) if C.OPTIMIZER in pd else None
         self.scheduler = SchedulerConfig(**pd.get(C.SCHEDULER, {})) if C.SCHEDULER in pd else None
         self.activation_checkpointing = ActivationCheckpointingConfig(

@@ -212,6 +212,18 @@ def test_batch_config_validation():
              "gradient_accumulation_steps": 2}, mesh_world_size=8)
 
 
+def test_hpz_partition_size_redirects_to_mics():
+    """zero_hpz_partition_size is a memory-affecting knob this framework
+    expresses differently (MiCS mesh axes) — it must fail loudly, not be
+    silently ignored."""
+    with pytest.raises(ValueError, match="mics_shard_size"):
+        deepspeed_tpu.DeepSpeedConfig(
+            {"train_micro_batch_size_per_gpu": 2,
+             "zero_optimization": {"stage": 3,
+                                   "zero_hpz_partition_size": 4}},
+            mesh_world_size=8)
+
+
 def test_fresh_engine_load_module_only(tmp_path):
     """load_checkpoint(..., load_module_only=True) into a FRESH engine:
     weights come from the checkpoint, optimizer state is freshly built

@@ -215,7 +215,7 @@ def long_context_bench(model_name="opt-350m", *, seq=8192, micro_bs=1,
 
 
 def hybrid_bench(model_name="opt-1.3b", *, train_bs=2, rollout_bs=8,
-                 prompt=256, gen=128, seq=2048, cycles=2, train_steps=2):
+                 prompt=256, gen=128, seq=2048, cycles=2, train_steps=4):
     """DS-Chat step-3 RLHF loop at OPT-1.3B scale through the Hybrid Engine
     (reference ``runtime/hybrid_engine.py:32``; headline rows in
     ``blogs/deepspeed-chat/README.md:38,52``): N ZeRO-3 train steps → rollout
@@ -229,9 +229,11 @@ def hybrid_bench(model_name="opt-1.3b", *, train_bs=2, rollout_bs=8,
     from deepspeed_tpu.models.opt import opt_config
     from deepspeed_tpu.models.transformer import Transformer
 
+    # remat OFF, like the north-star phase: even with the decode program
+    # resident, lean states leave room for full activations at bs2
+    # (r3 probe: 0.364 s/step vs 0.393 with remat)
     cfg = opt_config(model_name, max_seq_len=seq, dtype="bfloat16",
-                     remat=True, remat_policy="dots_and_attn_saveable",
-                     scan_layers=False, loss_seq_chunks=8)
+                     remat=False, scan_layers=False, loss_seq_chunks=8)
     model = Transformer(cfg)
     engine, *_ = deepspeed_tpu.initialize(
         model=model,

@@ -187,6 +187,13 @@ def resolve_remat_policy(name):
         return cp.save_from_both_policies(
             cp.dots_with_no_batch_dims_saveable,
             cp.save_only_these_names("flash_out", "flash_lse"))
+    if name == "flash_only_saveable":
+        # long-context middle ground: save ONLY the flash-attention
+        # residuals (out + lse, O(S) per layer) so the backward never
+        # re-runs the attention kernel, while every projection/MLP dot
+        # (O(S·M) each — the HBM hogs at long seq) is rematerialized
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse")
     return getattr(jax.checkpoint_policies, name, None)
 
 

@@ -42,6 +42,48 @@ def test_initialize_selects_hybrid_engine():
     assert isinstance(engine, DeepSpeedHybridEngine)
 
 
+def test_quantized_rollouts():
+    """hybrid_engine.quantize_rollouts: the inference view holds int8
+    payloads (re-derived from the current masters after each step), the
+    rollout program dequantizes in-trace, and training always sees the
+    exact masters."""
+    cfg = TransformerConfig(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                            num_heads=4, max_seq_len=64, dtype="float32",
+                            use_flash_attention=False)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=Transformer(cfg),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 3},
+            "hybrid_engine": {"enabled": True, "quantize_rollouts": True},
+        })
+    ids = np.random.default_rng(0).integers(0, VOCAB, (2, 8)).astype(np.int32)
+    out = np.asarray(engine.generate(ids, max_new_tokens=6))
+    assert out.shape == (2, 14)
+    assert (out >= 0).all() and (out < VOCAB).all()
+    # the view carries int8 payloads (weights are at-rest quantized)
+    from deepspeed_tpu.runtime.weight_quantizer import _is_qw
+    view = engine._inference_view()
+    qleaves = [l for l in jax.tree.leaves(
+        view, is_leaf=_is_qw) if _is_qw(l)]
+    assert qleaves, "no quantized leaves in the rollout view"
+    # masters stay full precision and training proceeds
+    assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(engine._params)
+               if jnp.issubdtype(l.dtype, jnp.floating))
+    losses = []
+    for i in range(4):
+        loss = engine(batch(i))
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert losses[-1] < losses[0]
+    # view re-derives from the stepped masters
+    out2 = np.asarray(engine.generate(ids, max_new_tokens=6))
+    assert engine._infer_params_step == engine.global_steps
+    assert out2.shape == out.shape
+
+
 def test_train_generate_interleave():
     engine = make_hybrid(zero_stage=3)
     ids = np.random.default_rng(0).integers(0, VOCAB, (2, 8)).astype(np.int32)

@@ -39,6 +39,51 @@ def test_bert_logit_parity_with_hf():
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
+def test_bert_mlm_trains_through_engine():
+    """BERT MLM fine-tuning through the engine (the reference's
+    bert-finetuning/bert-pretraining tutorials drive exactly this stack):
+    encoder-family training — not just inference parity — with ZeRO-2 and
+    the fused train step.  Loss on a fixed masked-token batch decreases."""
+    import flax.linen as nn
+    import deepspeed_tpu
+
+    cfg = BertConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                     num_heads=4, intermediate_size=64,
+                     max_position_embeddings=32, dtype="float32")
+    MASK = 63
+
+    class MLMTrain(nn.Module):
+        @nn.compact
+        def __call__(self, batch):
+            logits = BertForMaskedLM(cfg, name="bert")(
+                batch["input_ids"],
+                attention_mask=batch.get("attention_mask"))
+            labels = batch["labels"]
+            mask = (batch["input_ids"] == MASK).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            nll = -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0]
+            return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=MLMTrain(),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
+                "zero_optimization": {"stage": 2}})
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 63, (4 * engine.topology.dp, 32)).astype(np.int32)
+    ids = labels.copy()
+    ids[rng.random(ids.shape) < 0.3] = MASK
+    batch = {"input_ids": ids, "labels": labels}
+    losses = []
+    for _ in range(12):
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
 def test_bert_attention_mask_semantics():
     import torch
     from deepspeed_tpu.module_inject.replace_module import convert_hf_model

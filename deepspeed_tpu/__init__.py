@@ -145,6 +145,19 @@ def init_inference(model=None, config=None, **kwargs):
         from deepspeed_tpu.inference.config import normalize_dtype_str
         model, params = convert_hf_model(
             model, dtype=normalize_dtype_str(config.dtype))
+    if config.quant.kv_cache:
+        # int8 KV cache: flip the model-config knob (decoder families);
+        # warn instead of failing for models without a KV cache
+        cfg = getattr(model, "config", None)
+        if hasattr(cfg, "kv_cache_quant"):
+            if not cfg.kv_cache_quant:
+                import dataclasses
+                model = model.clone(
+                    config=dataclasses.replace(cfg, kv_cache_quant=True))
+        else:
+            from deepspeed_tpu.utils.logging import warning_once
+            warning_once(f"quant.kv_cache: {type(model).__name__} has no "
+                         "kv_cache_quant knob — ignored")
     return InferenceEngine(model, config, params=params)
 
 

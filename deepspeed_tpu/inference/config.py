@@ -41,6 +41,10 @@ class QuantizationConfig(DeepSpeedConfigModel):
     # streams int8 weights from HBM (groupwise reshape chains materialize a
     # bf16 copy of every weight each decode step instead)
     per_channel: bool = False
+    # int8 KV cache (TransformerConfig.kv_cache_quant): independent of
+    # weight quantization — applied to the model config by init_inference
+    # for models whose config carries the knob
+    kv_cache: bool = False
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):

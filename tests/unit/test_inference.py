@@ -162,6 +162,27 @@ def test_weight_quantized_inference():
     assert np.isfinite(got4).all()
 
 
+def test_init_inference_kv_cache_quant_knob():
+    """``quant.kv_cache`` through init_inference flips the model-config
+    int8-KV knob on decoder models and warns (not fails) on models
+    without one."""
+    from deepspeed_tpu.models.transformer import Transformer, TransformerConfig
+    import deepspeed_tpu
+
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_heads=4, max_seq_len=64, dtype="float32",
+                            use_flash_attention=False, scan_layers=False)
+    eng = deepspeed_tpu.init_inference(
+        Transformer(cfg),
+        config={"dtype": "float32", "quant": {"kv_cache": True}})
+    assert eng.module.config.kv_cache_quant
+    eng.init_params()
+    ids = np.random.default_rng(0).integers(0, 64, (2, 8)).astype(np.int32)
+    out = np.asarray(eng.generate(ids, max_new_tokens=6))
+    assert out.shape == (2, 14)
+    assert (out >= 0).all() and (out < 64).all()
+
+
 def test_untrusted_pickle_checkpoint_gated(model_and_params, tmp_path,
                                            monkeypatch):
     """Single-file checkpoint probing must never execute pickled code

@@ -368,9 +368,10 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
     # default precision), so single-token decode and batched prefill round
     # differently — logits agree to ~1e-2, not 1e-6.  Hardware numerics,
     # not a cache bug (the CPU mesh reproduces exact parity).
-    if S == 1 and bias is None and window is None:
+    if S == 1 and bias is None:
         # single-token decode: the Pallas online-softmax kernel streams the
-        # cache blockwise instead of materializing [B,H,1,S_max] fp32 logits
+        # cache blockwise instead of materializing [B,H,1,S_max] fp32
+        # logits; sliding windows (mistral-style) mask inside the kernel
         from deepspeed_tpu.ops.transformer.decode_attention import (
             decode_attention)
         from deepspeed_tpu.ops.transformer.flash_attention import (
@@ -380,7 +381,8 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
             return decode_attention(q[:, 0], k_cache, v_cache,
                                     lengths, layer=layer,
                                     k_scale=k_scale,
-                                    v_scale=v_scale)[:, None]
+                                    v_scale=v_scale,
+                                    window=window)[:, None]
     if layer is not None:
         # dense fallback needs the layer slice after all
         sl = lambda c: jax.lax.dynamic_index_in_dim(c, layer, 0,

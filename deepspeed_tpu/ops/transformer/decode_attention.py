@@ -52,7 +52,7 @@ DEFAULT_BLOCK_K_DECODE = int(_os.environ.get("DSTPU_DECODE_BLOCK_K", "512"))
 
 
 def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
-                   scale, block_k, nk, kvh, g, d, stacked, quant):
+                   scale, block_k, nk, kvh, g, d, stacked, quant, window):
     if quant:
         (ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr, qbd_scr) = rest
     else:
@@ -105,6 +105,11 @@ def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
         pos = ik * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1)                  # [1, bk]
         live = pos < length                              # cache tail mask
+        if window is not None:
+            # sliding-window decode (mistral-style): the single query sits
+            # at position length-1, so the live window is
+            # [length - window, length)
+            live = jnp.logical_and(live, pos >= length - window)
         s = jnp.where(live, s, NEG_INF)                  # [H, bk]
         m_prev = m_scr[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -134,7 +139,7 @@ def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
 
 def decode_attention(q, k_cache, v_cache, lengths,
                      scale=None, block_k=DEFAULT_BLOCK_K_DECODE, layer=None,
-                     k_scale=None, v_scale=None):
+                     k_scale=None, v_scale=None, window=None):
     """Single-token decode attention.
 
     q: [B, H, D] (this step's query); caches: [B, S_max, KVH*D]
@@ -205,7 +210,8 @@ def decode_attention(q, k_cache, v_cache, lengths,
     out = pl.pallas_call(
         functools.partial(_decode_kernel, scale=float(scale),
                           block_k=block_k, nk=nk, kvh=KVH, g=G, d=D,
-                          stacked=stacked, quant=quant),
+                          stacked=stacked, quant=quant,
+                          window=None if window is None else int(window)),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(B, nk),

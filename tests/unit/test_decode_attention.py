@@ -205,6 +205,30 @@ def test_int8_kv_generation_end_to_end():
     assert err < 0.02 * np.abs(ref).mean() + 1e-3, err
 
 
+@pytest.mark.parametrize("window", [8, 40, 200])
+def test_decode_sliding_window(window):
+    """Sliding-window decode (mistral-style) in-kernel: only the last
+    `window` positions before the query are live — matches the dense
+    cached_attention window path per batch row, including per-batch
+    lengths and windows larger than the live cache."""
+    B, H, D, S_max = 3, 4, 16, 128
+    rng = np.random.default_rng(window)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, S_max, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, S_max, D)), jnp.float32)
+    ks, vs = to_smajor(k), to_smajor(v)
+    lens = [5, 64, 128]
+    got = np.asarray(decode_attention(q[:, 0], ks, vs,
+                                      jnp.asarray(lens, jnp.int32),
+                                      block_k=32, window=window))
+    for b, L in enumerate(lens):
+        pos = jnp.asarray([[L - 1]], jnp.int32)
+        want = np.asarray(xla_cached_attention(
+            q[b:b + 1], ks[b:b + 1], vs[b:b + 1], pos,
+            window=window))[0, 0]
+        np.testing.assert_allclose(got[b], want, rtol=2e-5, atol=2e-5)
+
+
 def test_decode_short_lengths_exact():
     """Dead-region DMA pinning (indices past `lengths` pin to the last live
     block so Mosaic skips their copies) must not change results, including

@@ -74,6 +74,11 @@ def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
                 q[h * g:(h + 1) * g]
 
     length = len_ref[b]
+    run = ik * block_k < length
+    if window is not None:
+        # blocks entirely below the live window are skipped (their DMA is
+        # elided by the matching index-map pin) — decode cost is O(window)
+        run = jnp.logical_and(run, (ik + 1) * block_k > length - window)
 
     def _expand_scales(s_ref):
         # [bk, KVH] per-(position, kv-head) scales → [H, bk]: row r of the
@@ -86,8 +91,9 @@ def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
             return st
         return jnp.repeat(st, g, axis=0)                 # [H, bk]
 
-    # skip KV blocks entirely past the live cache region
-    @pl.when(ik * block_k < length)
+    # skip KV blocks entirely past the live cache region (and, with a
+    # window, entirely before it)
+    @pl.when(run)
     def _body():
         k = k_ref[0, 0] if stacked else k_ref[0]         # [bk, KVH*D]
         v = v_ref[0, 0] if stacked else v_ref[0]
@@ -178,9 +184,15 @@ def decode_attention(q, k_cache, v_cache, lengths,
     def _live_block(ik, lens, b):
         # pin indices past the live cache region to the last live block:
         # Mosaic skips the DMA when a block index repeats, so dead-region
-        # grid steps fetch nothing (their compute is pl.when-gated off too)
+        # grid steps fetch nothing (their compute is pl.when-gated off
+        # too).  With a sliding window, blocks entirely BELOW the window
+        # pin to its first block the same way — decode DMA is O(window)
         last = jnp.maximum((lens[b] + block_k - 1) // block_k - 1, 0)
-        return jnp.minimum(ik, last)
+        idx = ik
+        if window is not None:
+            first = jnp.maximum((lens[b] - window) // block_k, 0)
+            idx = jnp.maximum(idx, first)
+        return jnp.minimum(idx, last)
 
     if stacked:
         kv_spec = pl.BlockSpec(

@@ -113,7 +113,7 @@ def train_bench(model_name, *, micro_bs, zero_stage, steps, seq=2048,
 
 
 def decode_bench(model_name="opt-1.3b", *, batch_size=16, prompt=256,
-                 gen=256, int8=False, kv_int8=False):
+                 gen=256, int8=False, kv_int8=False, mxu_int8=False):
     """DS-Chat generation-phase workload (prompt 256 + gen 256) through the
     jitted prefill+decode program (reference Hybrid Engine `generate`,
     ``blogs/deepspeed-chat/README.md:265``).  ``int8=True`` runs the
@@ -136,7 +136,8 @@ def decode_bench(model_name="opt-1.3b", *, batch_size=16, prompt=256,
         device_peak_hbm_gbps
 
     cfg = opt_config(model_name, max_seq_len=prompt + gen, dtype="bfloat16",
-                     scan_layers=False, kv_cache_quant=kv_int8)
+                     scan_layers=False, kv_cache_quant=kv_int8,
+                     decode_int8_matmuls=mxu_int8)
     model = Transformer(cfg)
     quant = {"enabled": True, "bits": 8, "per_channel": True} if int8 else {}
     eng = InferenceEngine(model, DeepSpeedInferenceConfig(
@@ -380,6 +381,8 @@ def main():
     _phase_cleanup()
     # (3c) throughput-oriented serving point: at bs64 the KV stream
     # dominates decode traffic, so the int8 cache is worth ~17% more
+    # (decode_int8_matmuls measured NEUTRAL-to-slower here — the q/p
+    # quantize work offsets the cast savings; kept opt-in only)
     dec_int8_kv_bs64 = decode_bench("opt-1.3b", int8=True, kv_int8=True,
                                     batch_size=64, gen=128)
     _phase_cleanup()

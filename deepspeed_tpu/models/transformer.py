@@ -96,6 +96,13 @@ class TransformerConfig:
     # per-(position, kv-head) scales; decode is HBM-bound on the KV stream
     # at large batch, so halving its bytes buys real decode throughput
     kv_cache_quant: bool = False
+    # run the decode kernel's score/PV matmuls int8×int8 on the MXU
+    # (requires kv_cache_quant): removes the in-kernel int8→bf16 slab
+    # casts at the cost of additionally quantizing q and the probability
+    # rows (~0.5% extra attention error).  Measured NEUTRAL-to-slower on
+    # v5e at OPT-1.3B shapes (the quantize work offsets the cast
+    # savings) — opt-in for shapes where the KV stream dominates harder
+    decode_int8_matmuls: bool = False
     # "ulysses" | "ring" routes training attention through explicit
     # sequence-parallel collectives over the live sp mesh axis; None leaves
     # seq sharding to GSPMD constraint propagation
@@ -109,6 +116,10 @@ class TransformerConfig:
             raise ValueError("MoE trunk requires scan_layers=False (mixed "
                              "dense/MoE blocks are heterogeneous; expert "
                              "params shard over ep, not a layer axis)")
+        if self.decode_int8_matmuls and not self.kv_cache_quant:
+            raise ValueError("decode_int8_matmuls requires "
+                             "kv_cache_quant=True (the MXU path consumes "
+                             "int8 KV payloads)")
         if self.attention_layers is not None:
             if len(self.attention_layers) != self.num_layers:
                 raise ValueError(
@@ -349,7 +360,8 @@ def _cache_data(cache):
 
 
 def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
-                     window=None, layer=None, k_scale=None, v_scale=None):
+                     window=None, layer=None, k_scale=None, v_scale=None,
+                     int8_matmuls=False):
     """Decode attention against a KV cache.
 
     q: [B, S, H, D]; caches: [B, S_max, KVH*D] (S-major, heads flattened —
@@ -382,7 +394,8 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
                                     lengths, layer=layer,
                                     k_scale=k_scale,
                                     v_scale=v_scale,
-                                    window=window)[:, None]
+                                    window=window,
+                                    int8_matmuls=int8_matmuls)[:, None]
     if layer is not None:
         # dense fallback needs the layer slice after all
         sl = lambda c: jax.lax.dynamic_index_in_dim(c, layer, 0,
@@ -540,7 +553,8 @@ class Attention(nn.Module):
                 out = cached_attention(q, k_full, v_full, positions,
                                        bias=bias, window=window, layer=li,
                                        k_scale=scales.get("k_scale"),
-                                       v_scale=scales.get("v_scale"))
+                                       v_scale=scales.get("v_scale"),
+                                       int8_matmuls=cfg.decode_int8_matmuls)
                 new_cache = {"k": k_full, "v": v_full, **scales,
                              "layer": li,
                              **({"per_row": cache["per_row"]}
@@ -560,7 +574,8 @@ class Attention(nn.Module):
                 out = cached_attention(q, k_cache, v_cache, positions,
                                        bias=bias, window=window,
                                        k_scale=scales.get("k_scale"),
-                                       v_scale=scales.get("v_scale"))
+                                       v_scale=scales.get("v_scale"),
+                                       int8_matmuls=cfg.decode_int8_matmuls)
         else:
             out = _attention(q, k, v, cfg, mask=mask, bias=bias,
                              window=window)

@@ -52,11 +52,16 @@ DEFAULT_BLOCK_K_DECODE = int(_os.environ.get("DSTPU_DECODE_BLOCK_K", "512"))
 
 
 def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
-                   scale, block_k, nk, kvh, g, d, stacked, quant, window):
-    if quant:
+                   scale, block_k, nk, kvh, g, d, stacked, quant, window,
+                   mxu_int8):
+    if quant and mxu_int8:
+        (ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr, qbd_scr,
+         qs_scr) = rest
+    elif quant:
         (ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr, qbd_scr) = rest
+        qs_scr = None
     else:
-        ks_ref = vs_ref = None
+        ks_ref = vs_ref = qs_scr = None
         (o_ref, m_scr, l_scr, acc_scr, qbd_scr) = rest
     b = pl.program_id(0)
     ik = pl.program_id(1)
@@ -69,9 +74,17 @@ def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
         # build the block-diagonal Q once per batch row
         qbd_scr[:] = jnp.zeros_like(qbd_scr)
         q = q_ref[0]                                     # [H, D]
+        if mxu_int8:
+            # quantize q per head so the score matmul runs int8×int8 on
+            # the MXU — the [bk, KVH*D] slabs then never get cast
+            qf = q.astype(jnp.float32)
+            qs = jnp.max(jnp.abs(qf), axis=1, keepdims=True) / 127.0
+            qs = jnp.where(qs == 0.0, 1.0, qs)
+            qs_scr[:] = jnp.broadcast_to(qs, qs_scr.shape)
+            q = jnp.clip(jnp.round(qf / qs), -127, 127)
         for h in range(kvh):
             qbd_scr[h * g:(h + 1) * g, h * d:(h + 1) * d] = \
-                q[h * g:(h + 1) * g]
+                q[h * g:(h + 1) * g].astype(qbd_scr.dtype)
 
     length = len_ref[b]
     run = ik * block_k < length
@@ -97,15 +110,23 @@ def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
     def _body():
         k = k_ref[0, 0] if stacked else k_ref[0]         # [bk, KVH*D]
         v = v_ref[0, 0] if stacked else v_ref[0]
-        if quant:
+        if quant and not mxu_int8:
             # int8 payloads: cast for the MXU; the per-entry scale applies
             # to SCORES (k) and to P (v) — never to the big slabs, so no
             # [bk, KVH*D]-sized reshape/relayout happens in-kernel
             k = k.astype(qbd_scr.dtype)
             v = v.astype(qbd_scr.dtype)
         # all heads' scores in ONE matmul (see module docstring)
-        s = jax.lax.dot_general(qbd_scr[:], k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+        if mxu_int8:
+            # int8×int8 MXU path: the slabs go to the matmul untouched
+            s = jax.lax.dot_general(
+                qbd_scr[:], k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32).astype(jnp.float32)
+            s = s * (qs_scr[:, 0:1] * scale)
+        else:
+            s = jax.lax.dot_general(
+                qbd_scr[:], k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
         if quant:
             s = s * _expand_scales(ks_ref)
         pos = ik * block_k + jax.lax.broadcasted_iota(
@@ -127,9 +148,21 @@ def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
             l_scr.shape)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         pv = p * _expand_scales(vs_ref) if quant else p
-        o_flat = jax.lax.dot_general(pv.astype(v.dtype), v,
-                                     (((1,), (0,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
+        if mxu_int8:
+            # fold the v-scale into P, then quantize P per row: the PV
+            # matmul also runs int8×int8 with a per-row rescale after
+            rmax = jnp.max(pv, axis=1, keepdims=True) / 127.0
+            rsafe = jnp.where(rmax == 0.0, 1.0, rmax)
+            pv_i8 = jnp.clip(jnp.round(pv / rsafe), -127, 127) \
+                .astype(jnp.int8)
+            o_flat = jax.lax.dot_general(
+                pv_i8, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32).astype(jnp.float32)
+            o_flat = o_flat * rmax
+        else:
+            o_flat = jax.lax.dot_general(pv.astype(v.dtype), v,
+                                         (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
         # accumulate each head's D-column diagonal block of [H, KVH*D]
         for h in range(kvh):
             rows = slice(h * g, (h + 1) * g)
@@ -145,7 +178,8 @@ def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
 
 def decode_attention(q, k_cache, v_cache, lengths,
                      scale=None, block_k=DEFAULT_BLOCK_K_DECODE, layer=None,
-                     k_scale=None, v_scale=None, window=None):
+                     k_scale=None, v_scale=None, window=None,
+                     int8_matmuls=False):
     """Single-token decode attention.
 
     q: [B, H, D] (this step's query); caches: [B, S_max, KVH*D]
@@ -172,6 +206,10 @@ def decode_attention(q, k_cache, v_cache, lengths,
     quant = k_scale is not None
     if (k_scale is None) != (v_scale is None):
         raise ValueError("k_scale and v_scale must be given together")
+    if int8_matmuls and not quant:
+        raise ValueError("int8_matmuls requires quantized caches "
+                         "(k_scale/v_scale)")
+    mxu_int8 = bool(int8_matmuls)
     S_max, KVHD = k_cache.shape[-2], k_cache.shape[-1]
     KVH = KVHD // D
     G = H // KVH                                         # query heads per kv head
@@ -223,7 +261,8 @@ def decode_attention(q, k_cache, v_cache, lengths,
         functools.partial(_decode_kernel, scale=float(scale),
                           block_k=block_k, nk=nk, kvh=KVH, g=G, d=D,
                           stacked=stacked, quant=quant,
-                          window=None if window is None else int(window)),
+                          window=None if window is None else int(window),
+                          mxu_int8=mxu_int8),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(B, nk),
@@ -234,8 +273,10 @@ def decode_attention(q, k_cache, v_cache, lengths,
                 pltpu.VMEM((H, LSE_LANES), jnp.float32),
                 pltpu.VMEM((H, LSE_LANES), jnp.float32),
                 pltpu.VMEM((H, D), jnp.float32),
-                pltpu.VMEM((H, KVHD), q.dtype),
-            ]),
+                pltpu.VMEM((H, KVHD),
+                           jnp.int8 if mxu_int8 else q.dtype),
+            ] + ([pltpu.VMEM((H, LSE_LANES), jnp.float32)]
+                 if mxu_int8 else [])),
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),

@@ -205,6 +205,34 @@ def test_int8_kv_generation_end_to_end():
     assert err < 0.02 * np.abs(ref).mean() + 1e-3, err
 
 
+@pytest.mark.parametrize("kvh", [8, 2])
+def test_decode_int8_mxu_matmuls_accuracy(kvh):
+    """Full-int8 MXU decode (int8_matmuls): q and the probability rows are
+    additionally quantized so the score and PV matmuls run int8×int8 —
+    the output must stay within ~1% of the exact dequantized-reference
+    attention."""
+    B, H, D, S_max, L = 2, 8, 16, 96, 70
+    rng = np.random.default_rng(kvh + 100)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    k = rng.standard_normal((B, kvh, S_max, D)) * 2.0
+    v = rng.standard_normal((B, kvh, S_max, D))
+    ks, vs = to_smajor(jnp.asarray(k, jnp.float32)), \
+        to_smajor(jnp.asarray(v, jnp.float32))
+    kq, ksc = quantize_smajor(ks, kvh)
+    vq, vsc = quantize_smajor(vs, kvh)
+    lengths = jnp.asarray([L, 31], jnp.int32)
+    exact = np.asarray(decode_attention(q, kq, vq, lengths, block_k=32,
+                                        k_scale=ksc, v_scale=vsc))
+    fast = np.asarray(decode_attention(q, kq, vq, lengths, block_k=32,
+                                       k_scale=ksc, v_scale=vsc,
+                                       int8_matmuls=True))
+    err = np.abs(fast - exact).mean() / (np.abs(exact).mean() + 1e-9)
+    assert err < 0.015, err
+    # int8_matmuls without quantized caches is rejected
+    with pytest.raises(ValueError, match="int8_matmuls"):
+        decode_attention(q, ks, vs, lengths, int8_matmuls=True)
+
+
 @pytest.mark.parametrize("window", [8, 40, 200])
 def test_decode_sliding_window(window):
     """Sliding-window decode (mistral-style) in-kernel: only the last

@@ -84,6 +84,37 @@ def test_quantized_rollouts():
     assert out2.shape == out.shape
 
 
+def test_quantized_rollouts_with_int8_kv_cache():
+    """quantize_rollouts (int8 weight view) composes with kv_cache_quant
+    (int8 KV cache) — the full int8 rollout pipeline; training still sees
+    exact fp32 masters and the cache knob is inert for training."""
+    cfg = TransformerConfig(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                            num_heads=4, max_seq_len=64, dtype="float32",
+                            use_flash_attention=False, scan_layers=False,
+                            kv_cache_quant=True)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=Transformer(cfg),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 3},
+            "hybrid_engine": {"enabled": True, "quantize_rollouts": True},
+        })
+    ids = np.random.default_rng(0).integers(0, VOCAB, (2, 8)).astype(np.int32)
+    out = np.asarray(engine.generate(ids, max_new_tokens=6))
+    assert out.shape == (2, 14)
+    assert (out >= 0).all() and (out < VOCAB).all()
+    losses = []
+    for i in range(4):
+        loss = engine(batch(i))
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert losses[-1] < losses[0]
+    out2 = np.asarray(engine.generate(ids, max_new_tokens=6))
+    assert out2.shape == out.shape
+
+
 def test_train_generate_interleave():
     engine = make_hybrid(zero_stage=3)
     ids = np.random.default_rng(0).integers(0, VOCAB, (2, 8)).astype(np.int32)

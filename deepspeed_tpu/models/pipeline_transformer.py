@@ -28,7 +28,8 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 from deepspeed_tpu.models.transformer import (TransformerConfig, Block,
-                                              _norm, cross_entropy_loss)
+                                              _norm, cross_entropy_loss,
+                                              resolve_moe_offset)
 from deepspeed_tpu.runtime.pipe.module import (PipelineModule, LayerSpec,
                                                TiedLayerSpec)
 
@@ -166,6 +167,16 @@ def _infer_group_size(cfg: TransformerConfig) -> int:
     so group-relative ``layer_idx`` reproduces the absolute pattern."""
     g = 1
     if cfg.moe_num_experts > 0:
+        off = resolve_moe_offset(cfg)
+        if off >= cfg.moe_every:
+            # layers [0, off) form a dense prefix that breaks the period —
+            # group-relative layer_idx could no longer reproduce the
+            # absolute pattern (groups would silently come out all-dense)
+            raise ValueError(
+                f"moe_layer_offset={off} >= moe_every={cfg.moe_every}: the "
+                f"MoE pattern has an aperiodic dense prefix and cannot be "
+                f"stacked into a uniform pipeline trunk — use the plain "
+                f"Transformer (absolute layer indices) for this layout")
         g = math.lcm(g, cfg.moe_every)
     if cfg.attention_layers is not None:
         g = math.lcm(g, _pattern_period(tuple(cfg.attention_layers)))

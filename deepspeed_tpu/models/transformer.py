@@ -67,6 +67,11 @@ class TransformerConfig:
     # `moe_num_experts` experts sharded over the `ep` mesh axis.  0 = dense.
     moe_num_experts: int = 0
     moe_every: int = 2
+    # index of the FIRST MoE layer; -1 → `moe_every - 1` (the Megatron
+    # default, where MoE layers sit at every-1, 2*every-1, ...).  Lets
+    # checkpoints whose pattern starts elsewhere (e.g. layers 0,2,4 with
+    # interval 2) map without remapping layer indices.
+    moe_layer_offset: int = -1
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_eval_capacity_factor: float = 1.0
@@ -116,6 +121,19 @@ class TransformerConfig:
             raise ValueError("MoE trunk requires scan_layers=False (mixed "
                              "dense/MoE blocks are heterogeneous; expert "
                              "params shard over ep, not a layer axis)")
+        if self.moe_num_experts > 0:
+            if self.moe_layer_offset < -1:
+                raise ValueError(
+                    f"moe_layer_offset={self.moe_layer_offset}: only -1 "
+                    f"(the moe_every-1 default) or a layer index >= 0 is "
+                    f"meaningful")
+            off = resolve_moe_offset(self)
+            if off >= self.num_layers:
+                raise ValueError(
+                    f"first MoE layer {off} (moe_layer_offset/moe_every-1) "
+                    f"is past num_layers={self.num_layers} — the model "
+                    f"would silently build all-dense despite "
+                    f"moe_num_experts={self.moe_num_experts}")
         if self.decode_int8_matmuls and not self.kv_cache_quant:
             raise ValueError("decode_int8_matmuls requires "
                              "kv_cache_quant=True (the MXU path consumes "
@@ -408,12 +426,17 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
         # the WHOLE cache to full precision every step — the quantized
         # cache only pays off through the Pallas decode kernel (single
         # token, no alibi bias / sliding window)
-        from deepspeed_tpu.utils.logging import warning_once
-        warning_once(
-            "kv_cache_quant decode fell back to dense attention "
-            "(alibi bias, sliding window, multi-token step, or no Pallas "
-            "support) — the full cache is dequantized per step, so the "
-            "int8 cache SLOWS decode here instead of speeding it up")
+        if S == 1:
+            # multi-token prefill (S > 1) always takes this path and the
+            # one-off dequant there is expected — only a *decode* step
+            # landing here (alibi bias or no Pallas support) repeats the
+            # full-cache dequant every token and actually hurts
+            from deepspeed_tpu.utils.logging import warning_once
+            warning_once(
+                "kv_cache_quant decode fell back to dense attention "
+                "(alibi bias or no Pallas support) — the full cache is "
+                "dequantized per step, so the int8 cache SLOWS decode "
+                "here instead of speeding it up")
         deq = lambda c, s: (c.reshape(B, S_max, KVH, D).astype(jnp.float32)
                             * s[..., None]).astype(q.dtype)
         k_cache = deq(k_cache, k_scale)
@@ -608,9 +631,18 @@ class MLP(nn.Module):
         return dense(cfg.hidden_size, name="down_proj")(h)
 
 
+def resolve_moe_offset(cfg):
+    """The index of the first MoE layer; the -1 sentinel means
+    ``moe_every - 1`` (the Megatron default pattern)."""
+    off = cfg.moe_layer_offset
+    return cfg.moe_every - 1 if off < 0 else off
+
+
 def _is_moe_layer(cfg, layer_idx):
-    return (cfg.moe_num_experts > 0 and layer_idx is not None
-            and (layer_idx + 1) % cfg.moe_every == 0)
+    if cfg.moe_num_experts <= 0 or layer_idx is None:
+        return False
+    off = resolve_moe_offset(cfg)
+    return layer_idx >= off and (layer_idx - off) % cfg.moe_every == 0
 
 
 def _block_mlp(cfg, layer_idx, h, train=True):

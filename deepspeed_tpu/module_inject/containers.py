@@ -680,18 +680,25 @@ class MegatronGPTMoEPolicy(MegatronGPTPolicy):
 
     @staticmethod
     def detect_moe(sd):
-        """(num_experts, expert_interval) from a merged/normalized state
-        dict; (0, 0) when no MoE layers exist."""
+        """(num_experts, expert_interval, first_moe_layer) from a merged/
+        normalized state dict; (0, 0, 0) when no MoE layers exist.  The
+        interval is derived from the spacing between consecutive MoE layer
+        indices, so patterns that don't start at ``interval - 1`` (e.g.
+        layers 0,2,4 with interval 2) map too — only genuinely irregular
+        layouts (pyramid-residual etc.) are rejected."""
         import re as _re
-        moe_layers, experts = set(), set()
+        moe_layers, experts, all_layers = set(), set(), set()
         for k in sd:
+            lm = _re.match(r"transformer\.layers\.(\d+)\.", k)
+            if lm:
+                all_layers.add(int(lm.group(1)))
             m = _re.match(r"transformer\.layers\.(\d+)\.mlp\.deepspeed_moe\."
                           r"experts\.deepspeed_experts\.(\d+)\.", k)
             if m:
                 moe_layers.add(int(m.group(1)))
                 experts.add(int(m.group(2)))
         if not moe_layers:
-            return 0, 0
+            return 0, 0, 0
         # residual moe_type stores the dense blend branch as mlp.mlp.* and
         # the blend weights as mlp.coefficient.* (reference MoE layer's
         # use_residual members)
@@ -701,20 +708,31 @@ class MegatronGPTMoEPolicy(MegatronGPTPolicy):
             raise NotImplementedError(
                 "megatron moe_type='residual' checkpoints are not supported "
                 "(see MegatronGPTMoEPolicy docstring)")
-        first = min(moe_layers)
-        interval = first + 1
-        expect = set(range(first, 1 + max(moe_layers), interval))
+        ordered = sorted(moe_layers)
+        first = ordered[0]
+        # single MoE layer: spacing is undefined — an interval past the
+        # model depth makes exactly that one layer match the pattern
+        interval = ordered[1] - ordered[0] if len(ordered) > 1 \
+            else 1 + max(all_layers)
+        # the pattern must hold over the FULL model depth, not just the
+        # [first, last] MoE span — a truncated pattern (dense where the
+        # interval predicts an expert) would otherwise surface later as a
+        # bare KeyError deep in the per-layer weight mapping
+        expect = set(range(first, 1 + max(all_layers), interval))
         if moe_layers != expect:
             raise ValueError(
                 f"MoE layers {sorted(moe_layers)} are not a fixed "
-                f"expert-interval pattern")
-        return len(experts), interval
+                f"expert-interval pattern over all {1 + max(all_layers)} "
+                f"layers (supported: evenly spaced indices through the last "
+                f"layer; pyramid/residual layouts are not)")
+        return len(experts), interval, first
 
     def build_config(self, hf, **over):
         get = lambda n, d=None: getattr(hf, n, d)
         base = dict(
             moe_num_experts=get("num_experts", 0),
             moe_every=get("expert_interval", 2),
+            moe_layer_offset=get("first_moe_layer", -1),
             # megatron-deepspeed's arg name is 'topk'
             moe_top_k=get("moe_top_k", None) or get("topk", None) or 1,
             moe_expert_bias=True,

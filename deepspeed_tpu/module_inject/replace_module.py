@@ -140,7 +140,8 @@ def load_megatron_model(checkpoint, num_heads=None, megatron_v2=True,
     # MoE-GPT checkpoints (Megatron-DeepSpeed): per-expert MLPs under
     # mlp.deepspeed_moe.* on every expert_interval-th layer
     from deepspeed_tpu.module_inject.containers import MegatronGPTMoEPolicy
-    num_experts, expert_interval = MegatronGPTMoEPolicy.detect_moe(sd)
+    num_experts, expert_interval, first_moe_layer = \
+        MegatronGPTMoEPolicy.detect_moe(sd)
     dense_key = "transformer.layers.0.mlp.dense_h_to_4h.weight"
     h4h = sd[dense_key] if dense_key in sd else \
         sd["transformer.layers.0.mlp.deepspeed_moe.experts."
@@ -156,6 +157,7 @@ def load_megatron_model(checkpoint, num_heads=None, megatron_v2=True,
 
     _Args.num_experts = num_experts
     _Args.expert_interval = expert_interval
+    _Args.first_moe_layer = first_moe_layer if num_experts else -1
     if num_heads is None:
         raise ValueError("num_heads is not recoverable from a megatron "
                          "state dict — pass num_heads=")

@@ -10,6 +10,12 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+# a sitecustomize may pin a hardware platform before this script runs; the
+# live jax config must be updated before first device use (env is too late)
+if os.environ.get("DSTPU_ACCELERATOR") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
 
 def main():
     ap = argparse.ArgumentParser()
@@ -20,12 +26,6 @@ def main():
     args = ap.parse_args()
 
     import numpy as np
-
-# a sitecustomize may pin a hardware platform before this script runs; the
-# live jax config must be updated before first device use (env is too late)
-if os.environ.get("DSTPU_ACCELERATOR") == "cpu":
-    import jax
-    jax.config.update("jax_platforms", "cpu")
     import deepspeed_tpu
     from transformers import AutoTokenizer
 

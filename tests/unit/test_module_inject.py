@@ -343,6 +343,88 @@ def test_megatron_moe_checkpoint_loads():
     assert cfg_topk.moe_top_k == 2
 
 
+def test_megatron_moe_offset_pattern_loads():
+    """MoE layers that don't start at ``interval - 1`` (here layers 0, 2
+    with interval 2) are regular too — the interval comes from the spacing
+    between consecutive MoE layers, with the start offset preserved
+    (``moe_layer_offset``).  Genuinely irregular spacings still fail
+    loudly."""
+    from deepspeed_tpu.module_inject import load_megatron_model
+    from deepspeed_tpu.module_inject.containers import MegatronGPTMoEPolicy
+    from deepspeed_tpu.models.transformer import _is_moe_layer
+
+    rng = np.random.default_rng(13)
+    M, F, H, L, E, V, S = 32, 64, 4, 4, 4, 97, 32
+    r = lambda *s: rng.standard_normal(s).astype(np.float32) * 0.05
+    sd = {"word_embeddings.weight": r(V, M),
+          "position_embeddings.weight": r(S, M),
+          "transformer.final_layernorm.weight": np.ones(M, np.float32),
+          "transformer.final_layernorm.bias": np.zeros(M, np.float32)}
+    for i in range(L):
+        p = f"transformer.layers.{i}"
+        sd[f"{p}.input_layernorm.weight"] = np.ones(M, np.float32)
+        sd[f"{p}.input_layernorm.bias"] = np.zeros(M, np.float32)
+        sd[f"{p}.attention.query_key_value.weight"] = r(3 * M, M)
+        sd[f"{p}.attention.query_key_value.bias"] = r(3 * M)
+        sd[f"{p}.attention.dense.weight"] = r(M, M)
+        sd[f"{p}.attention.dense.bias"] = r(M)
+        sd[f"{p}.post_attention_layernorm.weight"] = np.ones(M, np.float32)
+        sd[f"{p}.post_attention_layernorm.bias"] = np.zeros(M, np.float32)
+        if i % 2 == 0:          # MoE at layers 0, 2: offset 0, interval 2
+            moe = f"{p}.mlp.deepspeed_moe"
+            sd[f"{moe}.gate.wg.weight"] = r(E, M)
+            for e in range(E):
+                ep = f"{moe}.experts.deepspeed_experts.{e}"
+                sd[f"{ep}.dense_h_to_4h.weight"] = r(F, M)
+                sd[f"{ep}.dense_h_to_4h.bias"] = r(F)
+                sd[f"{ep}.dense_4h_to_h.weight"] = r(M, F)
+                sd[f"{ep}.dense_4h_to_h.bias"] = r(M)
+        else:
+            sd[f"{p}.mlp.dense_h_to_4h.weight"] = r(F, M)
+            sd[f"{p}.mlp.dense_h_to_4h.bias"] = r(F)
+            sd[f"{p}.mlp.dense_4h_to_h.weight"] = r(M, F)
+            sd[f"{p}.mlp.dense_4h_to_h.bias"] = r(M)
+
+    assert MegatronGPTMoEPolicy.detect_moe(sd) == (E, 2, 0)
+    model, params = load_megatron_model(dict(sd), num_heads=H,
+                                        dtype="float32",
+                                        use_flash_attention=False)
+    cfg = model.config
+    assert cfg.moe_every == 2 and cfg.moe_layer_offset == 0
+    assert [_is_moe_layer(cfg, i) for i in range(L)] == \
+        [True, False, True, False]
+    # the stacked expert params landed on the offset layers
+    assert "moe_mlp" in params["params"]["layers_0"]
+    assert "moe_mlp" not in params["params"]["layers_1"]
+    ids = np.random.default_rng(7).integers(0, V, (2, 16)).astype(np.int32)
+    logits = np.asarray(jax.jit(
+        lambda p, i: model.apply(p, i, method=type(model).logits))(params, ids))
+    assert np.isfinite(logits).all()
+
+    # truncated pattern (MoE at 0, 2 but dense at the predicted layer 4 of
+    # a 6-layer model) fails loudly too — not a KeyError later in mapping
+    trunc = {k: v for k, v in sd.items()}
+    for i in (4, 5):
+        p = f"transformer.layers.{i}"
+        trunc[f"{p}.input_layernorm.weight"] = np.ones(M, np.float32)
+        trunc[f"{p}.mlp.dense_h_to_4h.weight"] = r(F, M)
+    with pytest.raises(ValueError, match="expert-interval"):
+        MegatronGPTMoEPolicy.detect_moe(trunc)
+
+    # irregular spacing (0, 2, 3) still fails loudly
+    bad = {k: v for k, v in sd.items()}
+    moe = "transformer.layers.3.mlp.deepspeed_moe"
+    bad[f"{moe}.gate.wg.weight"] = r(E, M)
+    for e in range(E):
+        ep = f"{moe}.experts.deepspeed_experts.{e}"
+        bad[f"{ep}.dense_h_to_4h.weight"] = r(F, M)
+        bad[f"{ep}.dense_h_to_4h.bias"] = r(F)
+        bad[f"{ep}.dense_4h_to_h.weight"] = r(M, F)
+        bad[f"{ep}.dense_4h_to_h.bias"] = r(M)
+    with pytest.raises(ValueError, match="expert-interval"):
+        MegatronGPTMoEPolicy.detect_moe(bad)
+
+
 def test_clip_text_encoder_parity():
     """CLIP text tower (reference ``containers/clip.py``): causal pre-LN
     quick-gelu encoder; our hidden_states must match HF last_hidden_state."""

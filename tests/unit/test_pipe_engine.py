@@ -129,6 +129,27 @@ def test_pipeline_moe_trunk_trains():
     assert losses[-1] < losses[0], f"moe trunk no learning: {losses}"
 
 
+def test_pipeline_moe_offset_trunk():
+    """An in-period MoE offset (first MoE layer < moe_every) pipelines and
+    places experts on the offset layers; an offset >= moe_every has an
+    aperiodic dense prefix and must fail loudly, not build an all-dense
+    trunk."""
+    engine = make_engine(pp=2, moe_num_experts=4, moe_ep_size=1,
+                         moe_every=2, moe_layer_offset=0)
+    batch = pipe_batch(seed=11)
+    losses = [float(jax.device_get(engine.train_batch(batch=batch)))
+              for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], f"offset moe trunk no learning: {losses}"
+    flat = jax.tree_util.tree_flatten_with_path(engine.params)[0]
+    names = [jax.tree_util.keystr(p) for p, _ in flat]
+    assert any("moe_mlp" in n for n in names), "experts missing from trunk"
+
+    with pytest.raises(ValueError, match="aperiodic"):
+        transformer_pipe(tiny_cfg(moe_num_experts=4, moe_ep_size=1,
+                                  moe_every=2, moe_layer_offset=3))
+
+
 def test_pipeline_postln_matches_dense_loss_at_init():
     """Post-LN pipelined loss at init lands at the uniform-prediction
     magnitude, like the dense model."""

@@ -397,8 +397,36 @@ def isend(tensor, dst, group=None, tag=0):
 
 irecv = isend
 
-# one outstanding send awaiting its recv (see send/recv below)
-_pending_send = []
+# outstanding sends awaiting their recv, each keyed by the trace that made
+# it (see send/recv below): pairing is only legal WITHIN one traced
+# program, and scoping the queue by trace identity means a send whose
+# trace aborted can never poison a later, innocent trace with a
+# leaked-tracer error.  Foreign entries are NEVER dropped eagerly — a
+# nested jit's send must not discard a still-live enclosing trace's
+# pending entry — only at a failing recv, where pairing is impossible
+# anyway and the stale entries get called out.
+_pending_send = []      # [(opaque_trace_state, tensor, dst, axes, tag)]
+
+
+def _current_trace_state():
+    from jax import core
+    return core.get_opaque_trace_state()
+
+
+def _drop_foreign_sends(state):
+    """Discard queued sends from other traces.  Called only from a recv
+    that found nothing to pair with in ITS trace: at that point the
+    foreign entries are either from aborted traces (dead) or evidence of
+    a pair split across jit boundaries (a bug being reported right now) —
+    either way they must not linger to confuse the next diagnosis."""
+    stale = [e for e in _pending_send if e[0] != state]
+    if stale:
+        _pending_send[:] = [e for e in _pending_send if e[0] == state]
+        logger.warning(
+            f"send/recv shim: dropping {len(stale)} unmatched send(s) "
+            f"queued by an earlier trace (their recv never executed — "
+            f"likely an aborted trace or a send/recv pair split across "
+            f"jit boundaries; pairs must live in ONE traced function)")
 
 
 def send(tensor, dst, group=None, tag=0):
@@ -415,13 +443,15 @@ def send(tensor, dst, group=None, tag=0):
     lowers to ONE :func:`p2p` collective (rank ``dst``'s ``recv`` returns
     rank ``src``'s ``x``; every other rank keeps its ``buf``).  Endpoints
     must be Python ints and each ``recv`` pairs with the OLDEST pending
-    ``send`` (FIFO, like tag-free torch p2p ordering), matching on group
-    and tag.  Genuinely dynamic patterns (traced endpoints, a ``recv``
-    with no pending ``send``, group/tag mismatches) raise with guidance,
-    because no single SPMD program can express them.  A ``send`` whose
-    ``recv`` never executes cannot be detected at trace time; its entry
-    stays queued, and a later ``recv`` pairing with it across an
-    aborted/finished trace fails loudly with JAX's leaked-tracer error."""
+    ``send`` *of the same trace* (FIFO, like tag-free torch p2p
+    ordering), matching on group and tag.  Genuinely dynamic patterns
+    (traced endpoints, a ``recv`` with no pending ``send``, group/tag
+    mismatches) raise with guidance, because no single SPMD program can
+    express them.  The pending queue is scoped to the live trace: a
+    ``send`` can never pair across traces, so an aborted step cannot
+    poison the one after it (stale entries sit inert until a failing
+    ``recv`` reports and drops them); a nested jit's own send/recv pair
+    coexists with an enclosing trace's pending send."""
     if not any(_is_traced(l) for l in jax.tree.leaves(tensor)):
         raise NotImplementedError(
             "send/recv are compiled collectives here: call the pair inside "
@@ -431,7 +461,8 @@ def send(tensor, dst, group=None, tag=0):
             "send(dst=...) must be a static Python int: a traced endpoint "
             "is rank-dynamic and has no single-program SPMD lowering — "
             "use dist.p2p/ppermute to express the whole exchange")
-    _pending_send.append((tensor, int(dst), _axes(group), tag))
+    _pending_send.append((_current_trace_state(), tensor, int(dst),
+                          _axes(group), tag))
     return tensor
 
 
@@ -440,13 +471,21 @@ def recv(tensor, src, group=None, tag=0):
     :func:`send`.  ``tensor`` is the receive buffer: returned unchanged on
     every rank except the send's ``dst``, which gets rank ``src``'s sent
     value."""
-    if not _pending_send:
+    state = _current_trace_state()
+    mine = [e for e in _pending_send if e[0] == state]
+    if not mine:
+        n_foreign = len(_pending_send)
+        _drop_foreign_sends(state)
         raise NotImplementedError(
-            "recv() without a preceding send(): under SPMD both halves of "
-            "the exchange execute on every rank — call send(x, dst) then "
-            "recv(buf, src) in the same traced function, or use "
-            "dist.p2p(tensor, src, dst, group) directly")
-    sent, dst, saxes, stag = _pending_send.pop(0)     # FIFO pairing
+            "recv() without a preceding send() in this trace: under SPMD "
+            "both halves of the exchange execute on every rank — call "
+            "send(x, dst) then recv(buf, src) in the SAME traced function, "
+            "or use dist.p2p(tensor, src, dst, group) directly"
+            + (f" ({n_foreign} stale send(s) from an earlier trace were "
+               f"queued and have been dropped)" if n_foreign else ""))
+    entry = mine[0]                                   # FIFO pairing
+    _pending_send.remove(entry)
+    _, sent, dst, saxes, stag = entry
     if not isinstance(src, int):
         raise NotImplementedError(
             "recv(src=...) must be a static Python int (see send())")

@@ -1,0 +1,30 @@
+"""Run a command on every host in the hostfile (reference ``bin/ds_ssh``).
+
+Package-level entry point so the installed console script works without
+repo-root ``sys.path`` tricks; ``bin/ds_ssh`` delegates here.
+"""
+
+import argparse
+import subprocess
+import sys
+
+from deepspeed_tpu.launcher.runner import fetch_hostfile
+
+
+def main():
+    p = argparse.ArgumentParser(description="run a command on all hosts")
+    p.add_argument("-H", "--hostfile", default="/job/hostfile")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args()
+    hosts = fetch_hostfile(args.hostfile) or {"localhost": 1}
+    cmd = " ".join(args.command) or "hostname"
+    rc = 0
+    for h in hosts:
+        print(f"=== {h} ===", flush=True)
+        r = subprocess.run(["ssh", "-o", "StrictHostKeyChecking=no", h, cmd])
+        rc = rc or r.returncode
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -17,14 +17,21 @@ import threading
 
 from deepspeed_tpu.utils.logging import logger
 
-_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__)))), "csrc")
+_PKG = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# repo layout (and editable installs): csrc/ sits NEXT TO the package;
+# a built wheel may instead carry it inside the package as package data
+_CSRC_CANDIDATES = (os.path.join(os.path.dirname(_PKG), "csrc"),
+                    os.path.join(_PKG, "csrc"))
 _lock = threading.Lock()
 _loaded = {}
 
 
 def csrc_path(*parts):
-    return os.path.join(_CSRC, *parts)
+    for root in _CSRC_CANDIDATES:
+        p = os.path.join(root, *parts)
+        if os.path.exists(p):
+            return p
+    return os.path.join(_CSRC_CANDIDATES[0], *parts)
 
 
 def _cache_dir():

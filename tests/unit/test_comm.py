@@ -192,3 +192,75 @@ def test_send_recv_mismatch_and_dynamic_raise(topo):
                         P(EDP_AXIS), P(EDP_AXIS))
     from deepspeed_tpu.comm.comm import _pending_send
     _pending_send.clear()
+
+
+def test_aborted_trace_send_does_not_poison_next(topo):
+    """A send whose trace aborts leaves a queued entry — the pending queue
+    is scoped by trace identity, so the NEXT trace's pair must run clean
+    (round-3 weakness: the stale entry paired across traces and raised
+    JAX's leaked-tracer error at the innocent call)."""
+    from deepspeed_tpu.comm.comm import _pending_send
+    _pending_send.clear()
+
+    def aborted(v):
+        dist.send(v, dst=3, group=(EDP_AXIS,))
+        raise RuntimeError("boom mid-trace")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        _run_collective(topo, aborted, jnp.zeros(8),
+                        P(EDP_AXIS), P(EDP_AXIS))
+    assert _pending_send, "aborted trace should have left a queued send"
+
+    x = jnp.arange(8.0) + 1.0
+
+    def pair(v):
+        dist.send(v, dst=5, group=(EDP_AXIS,))
+        return dist.recv(jnp.full_like(v, -1.0), src=2, group=(EDP_AXIS,))
+
+    out = _run_collective(topo, pair, x, P(EDP_AXIS), P(EDP_AXIS))
+    want = np.full(8, -1.0)
+    want[5] = 3.0                    # src=2 holds x[2] = 3.0
+    np.testing.assert_allclose(np.asarray(out), want)
+    # the stale entry sits inert (scoped to its dead trace) — it must not
+    # have paired with the clean trace's recv
+    assert len(_pending_send) == 1
+
+    # a recv orphaned by an aborted send still fails at ITS call site,
+    # with the stale entries dropped and called out
+    with pytest.raises(NotImplementedError, match="stale"):
+        _run_collective(topo,
+                        lambda v: dist.recv(v, src=0, group=(EDP_AXIS,)),
+                        jnp.zeros(8), P(EDP_AXIS), P(EDP_AXIS))
+    assert not _pending_send
+
+
+def test_nested_trace_pair_coexists_with_outer_send(topo):
+    """A nested jit's self-contained send/recv pair must not disturb an
+    enclosing trace's pending send: each pair lives in its own trace and
+    the queue is trace-scoped, not globally FIFO."""
+    from deepspeed_tpu.comm.comm import _pending_send
+    _pending_send.clear()
+    x = jnp.arange(8.0) + 1.0
+
+    def inner_pair(v):
+        dist.send(v, dst=1, group=(EDP_AXIS,))
+        return dist.recv(jnp.full_like(v, -7.0), src=6, group=(EDP_AXIS,))
+
+    inner_jit = None
+
+    def outer(v):
+        dist.send(v, dst=5, group=(EDP_AXIS,))          # outer pending
+        inner = inner_jit(v * 10.0)                     # own pair inside
+        got = dist.recv(jnp.full_like(v, -1.0), src=2, group=(EDP_AXIS,))
+        return got + inner
+
+    import jax
+    inner_jit = jax.jit(inner_pair)
+    out = _run_collective(topo, outer, x, P(EDP_AXIS), P(EDP_AXIS))
+    # outer pair: rank 5 got x[2]=3.0, others keep -1; inner pair: rank 1
+    # got 10*x[6]=70.0, others keep -7
+    want = np.full(8, -8.0)
+    want[5] = 3.0 - 7.0
+    want[1] = -1.0 + 70.0
+    np.testing.assert_allclose(np.asarray(out), want)
+    assert not _pending_send

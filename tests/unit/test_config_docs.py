@@ -1,0 +1,133 @@
+"""docs/config-json.md must cover every config block and field the parser
+accepts (the reference ships a 1,655-line full schema,
+``docs/_pages/config-json.md``; drift between parser and docs fails here).
+
+The check walks the pydantic models ``DeepSpeedConfig`` instantiates plus
+``DeepSpeedInferenceConfig`` and asserts each block has a doc section
+naming every field."""
+
+import os
+import re
+
+import pytest
+
+DOC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "..", "..", "docs", "config-json.md")
+
+# top-level JSON key -> config model
+def _blocks():
+    from deepspeed_tpu.runtime import config as rc
+    from deepspeed_tpu.inference.config import (DeepSpeedInferenceConfig,
+                                                QuantizationConfig)
+    return {
+        "fp16": rc.FP16Config,
+        "bf16": rc.BF16Config,
+        "zero_optimization": rc.ZeroConfig,
+        "zero_optimization.offload_optimizer":
+            rc.DeepSpeedZeroOffloadOptimizerConfig,
+        "zero_optimization.offload_param":
+            rc.DeepSpeedZeroOffloadParamConfig,
+        "optimizer": rc.OptimizerConfig,
+        "scheduler": rc.SchedulerConfig,
+        "activation_checkpointing": rc.ActivationCheckpointingConfig,
+        "flops_profiler": rc.FlopsProfilerConfig,
+        "comms_logger": rc.CommsLoggerConfig,
+        "tensorboard": rc.TensorBoardConfig,
+        "wandb": rc.WandbConfig,
+        "csv_monitor": rc.CSVConfig,
+        "tensor_parallel": rc.TensorParallelConfig,
+        "pipeline": rc.PipelineConfig,
+        "sequence_parallel": rc.SequenceParallelConfig,
+        "moe": rc.MoEConfig,
+        "aio": rc.AIOConfig,
+        "elasticity": rc.ElasticityConfig,
+        "compression_training": rc.CompressionConfig,
+        "curriculum_learning": rc.CurriculumLegacyConfig,
+        "data_efficiency": rc.DataEfficiencyConfig,
+        "autotuning": rc.AutotuningConfig,
+        "nebula": rc.NebulaConfig,
+        "init_inference": DeepSpeedInferenceConfig,
+        "init_inference.quant": QuantizationConfig,
+    }
+
+
+def _doc_sections():
+    """Split the doc into (heading, body) pairs at '##' headings."""
+    with open(DOC) as f:
+        text = f.read()
+    parts = re.split(r"^#{2,3} +(.+)$", text, flags=re.M)
+    head = parts[0]
+    sections = {}
+    for i in range(1, len(parts), 2):
+        sections[parts[i].strip()] = parts[i + 1]
+    return head, sections
+
+
+def _section_for(block, sections):
+    """The section whose heading mentions the block's JSON key."""
+    key = block.split(".")[-1]
+    for heading, body in sections.items():
+        tokens = re.findall(r"[`\w.]+", heading)
+        if any(key == t.strip("`") or t.strip("`").endswith("." + key)
+               or key in t.strip("`").split(",")
+               for t in tokens) or f"`{key}`" in heading:
+            return heading, body
+    # monitoring blocks share one section; inference sub-blocks are rows
+    # of the init_inference table
+    for heading, body in sections.items():
+        if f"`{key}`" in body or key in heading.lower():
+            return heading, body
+    return None, None
+
+
+def test_every_config_block_documented():
+    _, sections = _doc_sections()
+    missing = []
+    for block in _blocks():
+        heading, _ = _section_for(block, sections)
+        if heading is None:
+            missing.append(block)
+    assert not missing, f"config blocks with no doc section: {missing}"
+
+
+def test_every_config_field_documented():
+    _, sections = _doc_sections()
+    problems = []
+    for block, model in _blocks().items():
+        heading, body = _section_for(block, sections)
+        if body is None:
+            problems.append(f"{block}: no section")
+            continue
+        for name, field in model.model_fields.items():
+            spellings = {name}
+            if field.alias:
+                spellings.add(field.alias)
+            if not any(s in body for s in spellings):
+                problems.append(f"{block}.{name} missing from section "
+                                f"{heading!r}")
+    assert not problems, "undocumented config fields:\n" + \
+        "\n".join(problems)
+
+
+def test_top_level_scalars_documented():
+    """The scalar keys DeepSpeedConfig reads directly (outside any block
+    model) must appear in the doc too."""
+    with open(DOC) as f:
+        text = f.read()
+    for key in ("gradient_clipping", "prescale_gradients",
+                "gradient_predivide_factor", "sparse_gradients",
+                "steps_per_print", "wall_clock_breakdown", "dump_state",
+                "zero_allow_untested_optimizer", "seed",
+                "communication_data_type", "grad_accum_dtype",
+                "train_batch_size", "train_micro_batch_size_per_gpu",
+                "gradient_accumulation_steps",
+                "hybrid_engine", "quantize_rollouts", "rollout_quant_bits"):
+        assert key in text, f"top-level config key {key} undocumented"
+
+
+def test_doc_parity_scale():
+    """Guard against the docs regressing to a stub: the reference schema
+    doc is 1,655 lines; ours must stay a real schema document."""
+    with open(DOC) as f:
+        n = len(f.read().splitlines())
+    assert n >= 300, f"config-json.md shrank to {n} lines"

@@ -1,0 +1,205 @@
+"""Execute the MultiNodeRunner transports for real through fake
+``pdsh``/``mpirun``/``srun`` shims on PATH — each shim implements its
+backend's contract (per-host fan-out, env export flags, rank variable) by
+spawning the per-host command locally.  Unlike ``test_data_launcher.py``
+(command-string asserts only), these tests prove the built commands
+actually launch workers with correct env injection and rank assignment
+end-to-end (reference ``launcher/multinode_runner.py:51-265``)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+FAKE_PDSH = r'''#!/usr/bin/env python3
+"""pdsh contract: -S (max rc), -f fanout, -w host1,host2, then the remote
+command string; %n -> per-host rank, %h -> hostname (run locally here)."""
+import subprocess, sys
+args, hosts, cmd_parts, i = sys.argv[1:], [], [], 0
+while i < len(args):
+    a = args[i]
+    if a == "-w":
+        hosts = args[i + 1].split(","); i += 2
+    elif a == "-S":
+        i += 1
+    elif a == "-f":
+        i += 2
+    else:
+        cmd_parts.append(a); i += 1
+remote = " ".join(cmd_parts)
+procs = [subprocess.Popen(
+    ["bash", "-c", remote.replace("%n", str(n)).replace("%h", h)])
+    for n, h in enumerate(hosts)]
+sys.exit(max([p.wait() for p in procs] + [0]))
+'''
+
+FAKE_MPIRUN = r'''#!/usr/bin/env python3
+"""mpirun contract, both flavors the runners emit: OpenMPI (-n, --map-by,
+--host, --mca, -x K=V exports, OMPI_COMM_WORLD_RANK) and MPICH (-n, -ppn,
+-hosts, -genv K V exports, PMI_RANK)."""
+import os, subprocess, sys
+args, n, exports, tail, i = sys.argv[1:], 1, {}, [], 0
+rank_var = "OMPI_COMM_WORLD_RANK"
+while i < len(args):
+    a = args[i]
+    if a == "-n":
+        n = int(args[i + 1]); i += 2
+    elif a in ("--map-by", "--host"):
+        i += 2
+    elif a == "--mca":
+        i += 3
+    elif a == "-x":
+        k, v = args[i + 1].split("=", 1); exports[k] = v; i += 2
+    elif a == "-ppn":
+        rank_var = "PMI_RANK"; i += 2
+    elif a == "-hosts":
+        rank_var = "PMI_RANK"; i += 2
+    elif a == "-genv":
+        rank_var = "PMI_RANK"; exports[args[i + 1]] = args[i + 2]; i += 3
+    else:
+        tail = args[i:]; break
+procs = []
+for r in range(n):
+    env = dict(os.environ); env.update(exports); env[rank_var] = str(r)
+    procs.append(subprocess.Popen(tail, env=env))
+sys.exit(max([p.wait() for p in procs] + [0]))
+'''
+
+FAKE_SRUN = r'''#!/usr/bin/env python3
+"""srun contract the runner emits: -N nodes, --ntasks-per-node=1, -w
+hostlist, --export=ALL,K=V,..., SLURM_PROCID rank variable."""
+import os, subprocess, sys
+args, n, exports, tail, i = sys.argv[1:], 1, {}, [], 0
+while i < len(args):
+    a = args[i]
+    if a == "-N":
+        n = int(args[i + 1]); i += 2
+    elif a.startswith("--ntasks-per-node"):
+        i += 1
+    elif a == "-w":
+        i += 2
+    elif a.startswith("--export="):
+        for kv in a[len("--export="):].split(","):
+            if "=" in kv:
+                k, v = kv.split("=", 1); exports[k] = v
+        i += 1
+    elif a == "--comment":
+        i += 2
+    else:
+        tail = args[i:]; break
+procs = []
+for r in range(n):
+    env = dict(os.environ); env.update(exports); env["SLURM_PROCID"] = str(r)
+    procs.append(subprocess.Popen(tail, env=env))
+sys.exit(max([p.wait() for p in procs] + [0]))
+'''
+
+ECHO_WORKER = r'''import json, os, sys
+out = sys.argv[1]
+rank = os.environ["DSTPU_PROCESS_ID"]
+info = {k: os.environ.get(k) for k in
+        ("DSTPU_PROCESS_ID", "DSTPU_COORDINATOR_ADDRESS",
+         "DSTPU_NUM_PROCESSES")}
+info["cwd"] = os.getcwd()
+with open(os.path.join(out, f"rank{rank}.json"), "w") as f:
+    json.dump(info, f)
+'''
+
+
+def _shim_dir(tmp_path):
+    d = tmp_path / "fakebin"
+    d.mkdir()
+    for name, body in (("pdsh", FAKE_PDSH), ("mpirun", FAKE_MPIRUN),
+                       ("srun", FAKE_SRUN)):
+        p = d / name
+        p.write_text(body)
+        p.chmod(0o755)
+    return str(d)
+
+
+def _run_launcher(tmp_path, launcher, worker_args, extra_env=None,
+                  timeout=180):
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("nodeA slots=1\nnodeB slots=1\n")
+    env = dict(os.environ)
+    env["PATH"] = _shim_dir(tmp_path) + os.pathsep + env["PATH"]
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "-H", str(hostfile), "--launcher", launcher,
+         "--master_addr", "127.0.0.1", "--master_port", "29871",
+         *worker_args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
+
+
+@pytest.mark.parametrize("launcher", ["pdsh", "openmpi", "mpich", "slurm",
+                                      "mvapich"])
+def test_transport_spawns_ranked_workers(tmp_path, launcher):
+    """The runner-built command, executed through its backend's CLI
+    contract, spawns one worker per host with distinct ranks, the
+    coordinator env injected, and the launch cwd restored."""
+    worker = tmp_path / "echo_worker.py"
+    worker.write_text(ECHO_WORKER)
+    out = tmp_path / "out"
+    out.mkdir()
+    result = _run_launcher(tmp_path, launcher,
+                           [str(worker), str(out)])
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    records = {}
+    for f in os.listdir(out):
+        with open(out / f) as fh:
+            records[f] = json.load(fh)
+    assert len(records) == 2, (records, result.stderr)
+    ranks = sorted(int(r["DSTPU_PROCESS_ID"]) for r in records.values())
+    assert ranks == [0, 1], records
+    for r in records.values():
+        assert r["DSTPU_COORDINATOR_ADDRESS"] == "127.0.0.1:29871"
+        assert r["DSTPU_NUM_PROCESSES"] == "2"
+        assert r["cwd"] == REPO              # cd-to-launch-cwd contract
+
+
+@pytest.mark.slow
+def test_pdsh_transport_full_rendezvous(tmp_path):
+    """The pdsh transport end-to-end: two shim-spawned workers rendezvous
+    through jax.distributed.initialize into one 8-device mesh and produce
+    identical ZeRO-2 losses — the full multi-host path with only the ssh
+    hop replaced by the shim."""
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "mp_worker.py")
+    out = str(tmp_path / "losses")
+    port = _free_port()
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("nodeA slots=1\nnodeB slots=1\n")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("XLA_", "JAX_", "DSTPU_"))}
+    env.update({"DSTPU_REPO_ROOT": REPO, "WORKER_OUT": out,
+                "WORKER_LOCAL_DEVICES": "4"})
+    env["PATH"] = _shim_dir(tmp_path) + os.pathsep + env["PATH"]
+    result = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "-H", str(hostfile), "--launcher", "pdsh",
+         "--master_addr", "127.0.0.1", "--master_port", str(port), worker],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert result.returncode == 0, \
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+    with open(f"{out}.rank0") as f:
+        l0 = [float(x) for x in f.read().split()]
+    with open(f"{out}.rank1") as f:
+        l1 = [float(x) for x in f.read().split()]
+    np.testing.assert_allclose(l0, l1, rtol=0, atol=0)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -1,0 +1,50 @@
+"""Installable packaging (reference ``setup.py:292-295``): ``pip install``
+must produce working console entry points with no repo-root ``sys.path``
+insertion.  The install goes to a throwaway ``--prefix`` so the live
+environment is untouched; ``--no-deps --no-build-isolation`` keeps it
+fully offline."""
+
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+@pytest.mark.slow
+def test_pip_install_console_scripts(tmp_path):
+    prefix = tmp_path / "prefix"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pip", "install", "--no-deps",
+         "--no-build-isolation", "--prefix", str(prefix), REPO],
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+
+    bindir = prefix / "bin"
+    installed = {os.path.basename(p) for p in glob.glob(str(bindir / "*"))}
+    for script in ("deepspeed", "ds", "dsr", "deepspeed.pt", "ds_report",
+                   "ds_bench", "ds_elastic", "ds_ssh"):
+        assert script in installed, f"{script} missing from {installed}"
+
+    site = glob.glob(str(prefix / "lib" / "python*" / "site-packages"))
+    assert site, "no site-packages under the install prefix"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = site[0]
+    env.pop("BENCH_MODEL", None)
+    # the installed package must import and the CLI must answer --help
+    # WITHOUT the repo on sys.path (cwd is / so '' doesn't leak it in)
+    out = subprocess.run(
+        [str(bindir / "deepspeed"), "--help"], env=env, cwd="/",
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert "launcher" in (out.stdout + out.stderr).lower() or \
+        "usage" in (out.stdout + out.stderr).lower()
+
+    out = subprocess.run(
+        [str(bindir / "ds_report")], env=env, cwd="/",
+        capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert "deepspeed" in out.stdout.lower()

@@ -235,10 +235,15 @@ class InferenceEngine:
                top_k, top_p, with_mask)
         if key in self._compiled:
             return self._compiled[key]
+        # carry the quantized tree through the scan only when its dequant
+        # materializes full weights (see WeightQuantization
+        # .materializing_dequant for the why of both directions)
         self._compiled[key] = make_generate_fn(
             self.module, self.compute_dtype, prompt_len, max_new_tokens,
             do_sample, temperature, top_k, top_p,
-            param_transform=self._deq, with_mask=with_mask)
+            param_transform=self._deq, with_mask=with_mask,
+            carry_params=self._quantizer is not None
+            and self._quantizer.materializing_dequant)
         return self._compiled[key]
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
@@ -301,7 +306,8 @@ def require_right_padded(attention_mask):
 
 def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
                      do_sample, temperature, top_k, top_p,
-                     param_transform=None, with_mask=False):
+                     param_transform=None, with_mask=False,
+                     carry_params=None):
     """Build the jitted generation program: one-pass prefill + lax.scan
     decode loop with greedy / temperature / top-k / top-p sampling.  Shared
     by ``InferenceEngine`` and ``DeepSpeedHybridEngine``.
@@ -335,33 +341,53 @@ def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
             logits = jnp.where(logits < cutoff, -1e30, logits)
         return jax.random.categorical(rng, logits, axis=-1)
 
+    if carry_params is None:
+        carry_params = param_transform is not None
+
     def generate(params, input_ids, rng, eos_id, attention_mask=None):
         deq = param_transform if param_transform is not None else (lambda p: p)
         B = input_ids.shape[0]
         cache = module.init_cache(B, max_len, dtype=compute_dtype)
-        # prefill the prompt in one pass (dequant fused into the prefill)
-        logits, cache = module.apply(deq(params), input_ids, cache, 0,
-                                     method=type(module).decode)
-        rng, sub = jax.random.split(rng)
+        # prefill the prompt in one pass (dequant fused into the prefill),
+        # projecting ONLY each row's last real position through the vocab
+        # head — full [B, prompt, V] prefill logits are a multi-GB
+        # temporary at long prompts/large batches
         if with_mask:
             # right-padded rows: each row's next token comes from its LAST
             # REAL position and decoding continues at per-row offsets
             n = jnp.sum(attention_mask.astype(jnp.int32), axis=1)   # [B]
-            last = jnp.take_along_axis(logits, (n - 1)[:, None, None],
-                                       axis=1)[:, 0]
+            last_pos = n - 1
+        else:
+            n = None
+            last_pos = jnp.full((B,), prompt_len - 1, jnp.int32)
+        logits, cache = module.apply(deq(params), input_ids, cache, 0,
+                                     method=type(module).decode,
+                                     logits_at=last_pos)
+        rng, sub = jax.random.split(rng)
+        last = logits[:, 0]
+        if with_mask:
             pos0 = n
         else:
-            last = logits[:, -1]
             # scalar position: keeps the row-uniform cache-write fast path
             pos0 = jnp.asarray(prompt_len, jnp.int32)
         next_tok = sample_fn(last, sub)
 
-        # the quantized tree rides the scan CARRY and is dequantized inside
-        # the body: at the JAX level the compute-dtype weights are a per-step
-        # temporary, not a loop constant held live across the whole decode
+        # When the dequant MATERIALIZES full weights (grouped scales,
+        # int4, the hybrid rollout view) the quantized tree rides the
+        # scan CARRY and is dequantized inside the body: carried values
+        # are not loop-invariant to XLA, so the compute-dtype weights
+        # stay a per-step temporary instead of a hoisted 2x-size loop
+        # constant.  When the dequant FUSES into its consumers
+        # (per-channel int8, or no quantization at all), carrying would
+        # only copy the full tree into the loop's temp allocation
+        # (~1.4 GB at 1.3B int8) on top of the argument buffers — at
+        # bs128/seq384 that share of HBM pushed the program into XLA's
+        # staging mode and decode collapsed 8x — so those cases close
+        # over the argument buffers instead.
         def step(carry, _):
             tok, cache, pos, rng, done, qparams = carry
-            logits, cache = module.apply(deq(qparams), tok[:, None], cache,
+            p = deq(qparams if carry_params else params)
+            logits, cache = module.apply(p, tok[:, None], cache,
                                          pos, method=type(module).decode)
             rng, sub = jax.random.split(rng)
             nxt = sample_fn(logits[:, -1], sub)
@@ -371,7 +397,8 @@ def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
 
         done0 = (next_tok == eos_id)
         (_, _, _, _, _, _), toks = jax.lax.scan(
-            step, (next_tok, cache, pos0, rng, done0, params),
+            step, (next_tok, cache, pos0, rng, done0,
+                   params if carry_params else 0),
             None, length=max_new_tokens - 1)
         # HF contract: prompt + generated tokens
         return jnp.concatenate([input_ids, next_tok[:, None], toks.T], axis=1)

@@ -469,7 +469,7 @@ class Attention(nn.Module):
     layer_idx: Optional[int] = None
 
     @nn.compact
-    def __call__(self, x, positions, mask=None, cache=None):
+    def __call__(self, x, positions, mask=None, cache=None, prefill=False):
         cfg = self.config
         D, H, KVH = cfg.head_dim, cfg.num_heads, cfg.kv_heads
         window = cfg.window_for_layer(self.layer_idx)
@@ -513,6 +513,16 @@ class Attention(nn.Module):
             # flattened — the decode kernel's full-lane-width DMA layout;
             # the write below is the raw projection output, no transpose)
             B_, S_ = k.shape[0], k.shape[1]
+            # A full prefill (multi-token block starting at position 0 —
+            # the `prefill` static flag, set by hidden_states where the
+            # start is still statically visible) attends only within
+            # itself: route it through the flash/causal path on the fresh
+            # q/k/v instead of cached_attention's dense fallback, whose
+            # [B, H, S, S_max] fp32 score tensor is ~33 GB at a 4k
+            # prompt.  The cache is still written below; only the attend
+            # swaps.  (Alibi models keep the dense path: their bias is
+            # sized to the cache, not the prompt.)
+            prefill_from_zero = bool(prefill) and S_ > 1 and bias is None
             k_new = k.reshape(B_, S_, KVH * D)
             v_new = v.reshape(B_, S_, KVH * D)
             ks_new = vs_new = None
@@ -573,11 +583,13 @@ class Attention(nn.Module):
                                                     ks_new, li),
                               "v_scale": write_rows(cache["v_scale"],
                                                     vs_new, li)}
-                out = cached_attention(q, k_full, v_full, positions,
-                                       bias=bias, window=window, layer=li,
-                                       k_scale=scales.get("k_scale"),
-                                       v_scale=scales.get("v_scale"),
-                                       int8_matmuls=cfg.decode_int8_matmuls)
+                if not prefill_from_zero:
+                    out = cached_attention(
+                        q, k_full, v_full, positions,
+                        bias=bias, window=window, layer=li,
+                        k_scale=scales.get("k_scale"),
+                        v_scale=scales.get("v_scale"),
+                        int8_matmuls=cfg.decode_int8_matmuls)
                 new_cache = {"k": k_full, "v": v_full, **scales,
                              "layer": li,
                              **({"per_row": cache["per_row"]}
@@ -594,11 +606,19 @@ class Attention(nn.Module):
                 new_cache = {"k": k_cache, "v": v_cache, **scales,
                              **({"per_row": cache["per_row"]}
                                 if "per_row" in cache else {})}
-                out = cached_attention(q, k_cache, v_cache, positions,
-                                       bias=bias, window=window,
-                                       k_scale=scales.get("k_scale"),
-                                       v_scale=scales.get("v_scale"),
-                                       int8_matmuls=cfg.decode_int8_matmuls)
+                if not prefill_from_zero:
+                    out = cached_attention(
+                        q, k_cache, v_cache, positions,
+                        bias=bias, window=window,
+                        k_scale=scales.get("k_scale"),
+                        v_scale=scales.get("v_scale"),
+                        int8_matmuls=cfg.decode_int8_matmuls)
+            if prefill_from_zero:
+                # one shared prefill attend for both cache layouts: the
+                # cache was written above; the attention itself is plain
+                # causal flash over this block's fresh q/k/v
+                out = _attention(q, k, v, cfg, mask=None, bias=bias,
+                                 window=window)
         else:
             out = _attention(q, k, v, cfg, mask=mask, bias=bias,
                              window=window)
@@ -671,13 +691,19 @@ class Block(nn.Module):
 
 
     @nn.compact
-    def __call__(self, x, positions, mask=None, cache=None, train=True):
+    def __call__(self, x, positions, mask=None, cache=None, train=True,
+                 prefill=False):
+        # ``prefill``: STATIC bool — this call is a from-zero multi-token
+        # prefill, so attention can take the flash path over the fresh
+        # q/k/v (see Attention).  Threaded as a positional static arg
+        # because jax.checkpoint turns `positions` into a tracer, hiding
+        # the fact from any staticness test inside.
         cfg = self.config
         if not cfg.pre_layer_norm:
             # post-LN (opt-350m): norm follows each residual add
             attn, new_cache = Attention(cfg, layer_idx=self.layer_idx,
                                         name="attn")(x, positions, mask,
-                                                     cache)
+                                                     cache, prefill=prefill)
             x = _norm(cfg, "input_norm")(x + attn).astype(cfg.jnp_dtype)
             mlp_out, aux = _block_mlp(cfg, self.layer_idx, x, train=train)
             x = _norm(cfg, "post_attn_norm")(x + mlp_out).astype(cfg.jnp_dtype)
@@ -685,7 +711,7 @@ class Block(nn.Module):
         normed = _norm(cfg, "input_norm")(x).astype(cfg.jnp_dtype)
         attn, new_cache = Attention(cfg, layer_idx=self.layer_idx,
                                     name="attn")(normed, positions, mask,
-                                                 cache)
+                                                 cache, prefill=prefill)
         if cfg.parallel_residual:
             mlp_in = normed if cfg.shared_attn_mlp_norm else \
                 _norm(cfg, "post_attn_norm")(x).astype(cfg.jnp_dtype)
@@ -711,9 +737,10 @@ class ScanBlock(Block):
     ~full-HBM-cache write per generated token)."""
 
     @nn.compact
-    def __call__(self, carry, positions, mask=None):
+    def __call__(self, carry, positions, mask=None, prefill=False):
         x, cache = carry
-        x, new_cache, aux = Block.__call__(self, x, positions, mask, cache)
+        x, new_cache, aux = Block.__call__(self, x, positions, mask, cache,
+                                           True, prefill)
         if new_cache is not None:
             new_cache = dict(new_cache, layer=new_cache["layer"] + 1)
         return (x, new_cache), aux
@@ -747,18 +774,21 @@ class Transformer(nn.Module):
         block = ScanBlock if cfg.scan_layers else Block
         if cfg.remat:
             policy = resolve_remat_policy(cfg.remat_policy)
-            # non-scan Block takes `train` as positional arg 5 (counting
-            # self) — it gates Python control flow in the MoE gate and must
-            # stay a static bool through jax.checkpoint (ScanBlock has no
-            # train arg; kwargs are not covered by static_argnums)
-            static = () if cfg.scan_layers else (5,)
+            # `train` and `prefill` gate Python control flow (MoE gate
+            # regime / flash-vs-cached attention) and must stay static
+            # bools through jax.checkpoint, so they ride positionally:
+            # non-scan Block(self, x, positions, mask, cache, train,
+            # prefill) -> (5, 6); ScanBlock(self, carry, positions, mask,
+            # prefill) -> (4,).  (kwargs are not covered by
+            # static_argnums.)
+            static = (4,) if cfg.scan_layers else (5, 6)
             block = nn.remat(block, policy=policy, static_argnums=static)
         if cfg.scan_layers:
             self.blocks = nn.scan(
                 block,
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "dropout": True},
-                in_axes=(nn.broadcast, nn.broadcast),
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="layers")
@@ -794,12 +824,21 @@ class Transformer(nn.Module):
         if cfg.embedding_norm:
             x = self.embed_norm(x).astype(cfg.jnp_dtype)
         marker = {"per_row": jnp.zeros((), jnp.int32)} if per_row_pos else {}
+        # from-zero multi-token prefill, decided where the start is
+        # still STATICALLY visible (generation passes a literal 0;
+        # inside the remat-wrapped block `positions` is a tracer):
+        # attention then takes the flash path over the fresh q/k/v
+        # instead of the dense cached fallback (see Attention)
+        prefill = (cache is not None and S > 1
+                   and isinstance(start_pos, (int, np.integer))
+                   and int(start_pos) == 0)
         if cfg.scan_layers:
             carry_cache = None if cache is None else \
                 {**_cache_data(cache),
                  "layer": jnp.asarray(0, jnp.int32), **marker}
             (x, out_cache), aux_layers = self.blocks((x, carry_cache),
-                                                     positions, mask)
+                                                     positions, mask,
+                                                     prefill)
             aux = jnp.sum(aux_layers)
             new_cache = None if cache is None else _cache_data(out_cache)
         else:
@@ -810,8 +849,10 @@ class Transformer(nn.Module):
             for i, blk in enumerate(self.block_list):
                 layer_cache = None if cur is None else \
                     {**cur, "layer": jnp.asarray(i, jnp.int32), **marker}
-                # train positional: static_argnums only covers positionals
-                x, nc, a = blk(x, positions, mask, layer_cache, train)
+                # train/prefill positional: static_argnums only covers
+                # positionals
+                x, nc, a = blk(x, positions, mask, layer_cache, train,
+                               prefill)
                 if cur is not None:
                     cur = _cache_data(nc)
                 aux = aux + a
@@ -863,11 +904,20 @@ class Transformer(nn.Module):
     def logits(self, input_ids, mask=None):
         return self._head(self.hidden_states(input_ids, mask, train=False))
 
-    def decode(self, input_ids, cache, start_pos):
+    def decode(self, input_ids, cache, start_pos, logits_at=None):
         """KV-cached decode/prefill step: returns (logits, new_cache).
-        ``input_ids``: [B, S_step]; positions are ``start_pos + arange``."""
+        ``input_ids``: [B, S_step]; positions are ``start_pos + arange``.
+
+        ``logits_at`` ([B] int32, optional): project ONLY these per-row
+        positions through the vocab head, returning [B, 1, V].  Generation
+        prefill needs just each row's last real position — the full
+        [B, S, V] prefill logits are a multi-GB temporary at long prompts
+        (bs16 x 3968 x 50k vocab = 6.4 GB bf16) that OOMs a 16 GB chip."""
         h, new_cache = self.hidden_states(input_ids, cache=cache,
                                           start_pos=start_pos, train=False)
+        if logits_at is not None:
+            h = jnp.take_along_axis(
+                h, logits_at.astype(jnp.int32)[:, None, None], axis=1)
         return self._head(h), new_cache
 
     def init_cache(self, batch_size, max_len, dtype=None):

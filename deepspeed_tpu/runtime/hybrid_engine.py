@@ -263,12 +263,18 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                float(temperature), int(top_k), float(top_p),
                attention_mask is not None)
         if key not in self._gen_compiled:
+            # carry the rollout view through the decode scan only when its
+            # dequant materializes full weights (see WeightQuantization
+            # .materializing_dequant); the plain bf16 view stays an
+            # argument buffer (no loop-temp copy)
             self._gen_compiled[key] = make_generate_fn(
                 self.module, self.compute_dtype, input_ids.shape[1],
                 int(max_new_tokens), bool(do_sample), float(temperature),
                 int(top_k), float(top_p),
                 param_transform=self._rollout_deq,
-                with_mask=attention_mask is not None)
+                with_mask=attention_mask is not None,
+                carry_params=self._rollout_quantizer is not None
+                and self._rollout_quantizer.materializing_dequant)
         params = self._inference_view()
         args = (params, input_ids, rng, jnp.asarray(eos_token_id))
         if attention_mask is not None:

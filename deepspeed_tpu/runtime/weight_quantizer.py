@@ -100,6 +100,14 @@ class WeightQuantization:
         self.group_size = group_size
         self.symmetric = symmetric
         self.min_ndim = min_ndim
+        # whether dequantize_tree MATERIALIZES full compute-dtype weights
+        # (grouped scales / int4: reshape chains) vs a bare convert×scale
+        # that XLA fuses into each consumer (per-channel int8).  Decode
+        # loops key on this: a materializing dequant should ride the scan
+        # carry (else XLA hoists a full-size weight copy out of the loop);
+        # a fusable one should not (the carry would copy the tree into
+        # loop temps for nothing).
+        self.materializing_dequant = not self.per_channel
         self.skip_patterns = tuple(p.lower() for p in skip_patterns)
         # token-anchored (like state_dict_factory._classify): short patterns
         # must not fire inside unrelated names; precompiled once

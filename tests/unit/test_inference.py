@@ -49,6 +49,33 @@ def test_cached_decode_matches_full_forward(model_and_params):
         pos += 1
 
 
+def test_carry_params_variants_agree(model_and_params):
+    """``make_generate_fn``'s two scan structures — params riding the
+    carry (materializing dequants) vs closed over as argument buffers
+    (fusable/no dequant, the bs128 HBM fix) — must produce identical
+    tokens; only where the weight buffers live differs."""
+    from deepspeed_tpu.inference.engine import make_generate_fn
+    model, params, ids = model_and_params
+    rng = jax.random.key(7)
+    outs = []
+    for carry in (False, True):
+        fn = make_generate_fn(model, jnp.float32, ids.shape[1], 8,
+                              False, 1.0, 0, 1.0, carry_params=carry)
+        outs.append(np.asarray(fn(params, ids, rng, -1)))
+    np.testing.assert_array_equal(outs[0], outs[1])
+    # and the masked (padded-prompt) variant, sampled, both ways
+    mask = np.ones(ids.shape, np.int32)
+    mask[1, -3:] = 0
+    outs = []
+    for carry in (False, True):
+        fn = make_generate_fn(model, jnp.float32, ids.shape[1], 8,
+                              True, 0.8, 0, 0.9, with_mask=True,
+                              carry_params=carry)
+        outs.append(np.asarray(fn(params, ids, rng, -1,
+                                  jnp.asarray(mask))))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
 def test_greedy_generation_deterministic(model_and_params):
     model, params, ids = model_and_params
     engine = deepspeed_tpu.init_inference(model, config={"dtype": "float32"})

@@ -97,37 +97,64 @@ def calibrate_bench():
 
     on_cpu = jax.devices()[0].platform == "cpu"
 
-    # --- streaming bandwidth ---
-    # scale by 1 + 2^-7, the smallest bf16 step above 1.0 (7 mantissa
-    # bits): a "nicer" 1.0001 rounds to bf16 1.0 and XLA folds the whole
-    # multiply into identity — zero traffic, absurd numbers.  Completion
-    # via the dependent-sync fence (device_get of a derived scalar), which
-    # the tunneled device honors where block_until_ready under-waits.
+    # Measurement hygiene, both learned the hard way on the tunneled
+    # device: (1) every rep must live INSIDE one compiled program — each
+    # separate execution pays ~30-140 ms of tunnel dispatch overhead, so
+    # chained jit calls measure the tunnel, not the chip; (2) timing two
+    # rep counts and differencing cancels the remaining per-execution
+    # overhead (same trick the decode bench uses for prefill); (3) the
+    # loop body must not be constant-foldable — a scale below 1 + 2^-7
+    # rounds to bf16 1.0 and compiles to identity, and multiplying by the
+    # SAME scalar every iteration folds to one multiply, so the scalar
+    # rides the loop carry and changes per step; (4) completion via the
+    # dependent-sync fence (block_until_ready under-waits here).
+    def timed_loop(build, warm_arg, reps):
+        fn = jax.jit(build, static_argnums=(1,))
+        _sync_scalar(fn(warm_arg, reps))           # compile + warm
+        _sync_scalar(fn(warm_arg, 2 * reps))
+        # one differenced pair only cancels the MEAN dispatch overhead;
+        # the tunnel's jitter spans tens of ms, so take the best of
+        # several pairs (min of positive diffs = least-contended sample)
+        diffs = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _sync_scalar(fn(warm_arg, reps))
+            t1 = time.perf_counter()
+            _sync_scalar(fn(warm_arg, 2 * reps))
+            t2 = time.perf_counter()
+            d = (t2 - t1) - (t1 - t0)
+            if d > 0:
+                diffs.append(d)
+        if not diffs:
+            raise RuntimeError(
+                "calibration: dispatch jitter swamped the measurement "
+                "(all differenced pairs were non-positive)")
+        return min(diffs) / reps                   # per-rep, overhead-free
+
+    # --- streaming bandwidth: v = v * s with a per-iteration scalar ---
     n = ((1 << 26) if on_cpu else (1 << 30)) // 2   # 1 GiB bf16 (64 MiB cpu)
     x = jnp.ones((n,), jnp.bfloat16)
     assert float(jnp.bfloat16(1.0078125)) != 1.0    # really a multiply
-    scale_fn = jax.jit(lambda v: v * jnp.bfloat16(1.0078125))
-    _sync_scalar(scale_fn(x)[0])             # compile + warm
-    reps = 8
-    t0 = time.perf_counter()
-    y = x
-    for _ in range(reps):
-        y = scale_fn(y)
-    _sync_scalar(y[0])
-    dt = (time.perf_counter() - t0) / reps
+
+    def bw(v, reps):
+        def body(_, carry):
+            v, s = carry
+            return v * s, s + jnp.bfloat16(0.0078125)
+        out, _ = jax.lax.fori_loop(0, reps, body,
+                                   (v, jnp.bfloat16(1.0078125)))
+        return out[0]
+
+    dt = timed_loop(bw, x, 16)
     measured_gbps = 2 * x.nbytes / dt / 1e9  # read + write per element
 
-    # --- MXU matmul ---
+    # --- MXU matmul: out = out @ a, data-dependent, unfoldable ---
     m = 1024 if on_cpu else 8192
     a = jnp.full((m, m), 1.0 / m, jnp.bfloat16)   # fixed point of p @ a
-    mm = jax.jit(lambda p, q: p @ q)
-    _sync_scalar(mm(a, a)[0, 0])
-    t0 = time.perf_counter()
-    out = a
-    for _ in range(4):
-        out = mm(out, a)
-    _sync_scalar(out[0, 0])
-    dt = (time.perf_counter() - t0) / 4
+
+    def mm(p, reps):
+        return jax.lax.fori_loop(0, reps, lambda _, o: o @ p, p)[0, 0]
+
+    dt = timed_loop(mm, a, 4 if on_cpu else 8)
     measured_tflops = 2 * m ** 3 / dt / 1e12
 
     const_tflops, const_gbps = device_peak_tflops(), device_peak_hbm_gbps()

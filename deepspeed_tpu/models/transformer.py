@@ -320,6 +320,21 @@ def reference_attention(q, k, v, causal=True, mask=None, bias=None,
     return jnp.einsum("bhst,bthd->bshd", probs, v)
 
 
+def _prefill_attention(q, k, v, config, window=None):
+    """Causal self-attention for a from-zero generation prefill: ONLY the
+    flash kernel or the dense causal reference — never ``_attention``'s
+    sequence-parallel shard_map or block-sparse branches.  Generation
+    inputs are unsharded (an sp>1 topology would shard_map over them and
+    crash or mis-attend), and decode attends dense over the same cache,
+    so a sparse prefill would silently diverge from its own decode."""
+    if window is None and config.use_flash_attention and q.shape[1] > 1:
+        from deepspeed_tpu.ops.transformer.flash_attention import (
+            flash_attention, pallas_supported)
+        if pallas_supported():
+            return flash_attention(q, k, v, causal=True)
+    return reference_attention(q, k, v, causal=True, window=window)
+
+
 def _attention(q, k, v, config, mask=None, bias=None, window=None):
     if window is not None:
         # banded local attention (gpt-neo): dense path with a band mask —
@@ -616,9 +631,9 @@ class Attention(nn.Module):
             if prefill_from_zero:
                 # one shared prefill attend for both cache layouts: the
                 # cache was written above; the attention itself is plain
-                # causal flash over this block's fresh q/k/v
-                out = _attention(q, k, v, cfg, mask=None, bias=bias,
-                                 window=window)
+                # causal flash over this block's fresh q/k/v (bias is
+                # None by the prefill_from_zero condition)
+                out = _prefill_attention(q, k, v, cfg, window=window)
         else:
             out = _attention(q, k, v, cfg, mask=mask, bias=bias,
                              window=window)

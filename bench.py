@@ -600,7 +600,10 @@ def run_phase(name, fallback, out_path):
     if os.environ.get("BENCH_TEST_FAIL_ALWAYS") == name:
         raise RuntimeError("injected unconditional failure")
     _setup_compile_cache()
-    runner = next(r for _, n, r in PHASES if n == name)
+    runner = next((r for _, n, r in PHASES if n == name), None)
+    if runner is None:
+        raise SystemExit(f"unknown phase {name!r}; valid: "
+                         f"{', '.join(n for _, n, _ in PHASES)}")
     result = runner(fallback)
     if fallback:
         result["fallback"] = True
@@ -637,13 +640,17 @@ def _spawn_phase(name, fallback, timeout_s, extra_env):
     env = dict(os.environ)
     env.update(extra_env)
     t0 = time.perf_counter()
+    timed_out = False
+    rc = None
     try:
         with open(log_path, "w") as log:
             proc = subprocess.run(cmd, stdout=log, stderr=subprocess.STDOUT,
                                   env=env, timeout=timeout_s)
         rc = proc.returncode
     except subprocess.TimeoutExpired:
-        rc = -1
+        # distinct from any child returncode (a SIGHUP death is rc=-1 and
+        # must not be mislabeled a timeout)
+        timed_out = True
     wall = time.perf_counter() - t0
     if rc == 0 and os.path.exists(out_path):
         with open(out_path) as f:
@@ -654,7 +661,7 @@ def _spawn_phase(name, fallback, timeout_s, extra_env):
     if os.path.exists(log_path):
         with open(log_path, errors="replace") as f:
             tail = f.read()[-2000:]
-    reason = f"timeout after {timeout_s}s" if rc == -1 else f"rc={rc}"
+    reason = f"timeout after {timeout_s}s" if timed_out else f"rc={rc}"
     return None, f"{reason}; log tail: {tail}", wall
 
 

@@ -413,6 +413,26 @@ def _current_trace_state():
     return core.get_opaque_trace_state()
 
 
+def _prune_dead_sends():
+    """Drop queued sends whose trace has been garbage-collected (an aborted
+    or completed-without-recv trace).  ``OpaqueTraceState`` holds a WEAKREF
+    to its trace, so deadness is precise: a live enclosing trace (nested
+    jit) is never touched, but repeated aborted traces cannot accumulate
+    entries (each pinning its traced tensor) for the life of the process.
+    Called opportunistically from the happy path of send()/recv()."""
+    # identity-based filtering: tuple equality would compare the queued
+    # TRACED tensors (ambiguous truth value / leaked-tracer errors)
+    dead_ids = {id(e) for e in _pending_send
+                if getattr(e[0], "_trace_ref", lambda: True)() is None}
+    if dead_ids:
+        _pending_send[:] = [e for e in _pending_send
+                            if id(e) not in dead_ids]
+        logger.warning(
+            f"send/recv shim: pruned {len(dead_ids)} queued send(s) from "
+            f"dead trace(s) (their recv never executed — likely aborted "
+            f"traces; send/recv pairs must complete in ONE traced function)")
+
+
 def _drop_foreign_sends(state):
     """Discard queued sends from other traces.  Called only from a recv
     that found nothing to pair with in ITS trace: at that point the
@@ -461,6 +481,7 @@ def send(tensor, dst, group=None, tag=0):
             "send(dst=...) must be a static Python int: a traced endpoint "
             "is rank-dynamic and has no single-program SPMD lowering — "
             "use dist.p2p/ppermute to express the whole exchange")
+    _prune_dead_sends()
     _pending_send.append((_current_trace_state(), tensor, int(dst),
                           _axes(group), tag))
     return tensor
@@ -472,6 +493,7 @@ def recv(tensor, src, group=None, tag=0):
     every rank except the send's ``dst``, which gets rank ``src``'s sent
     value."""
     state = _current_trace_state()
+    _prune_dead_sends()
     mine = [e for e in _pending_send if e[0] == state]
     if not mine:
         n_foreign = len(_pending_send)

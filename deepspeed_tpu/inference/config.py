@@ -66,6 +66,18 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     injection_policy: Optional[Dict] = None
     return_tuple: bool = True
     triangular_masking: bool = True
+    # serving-config guardrail (reference analog: workspace-size checks in
+    # inference_context.h): at compile time, compare the generation
+    # program's argument+temp bytes against this fraction of device memory
+    # — near/above it XLA silently switches to staging buffers and decode
+    # collapses nonlinearly (measured 8x; docs/performance.md "measure the
+    # cliff").  Warn above the fraction; refuse when ``strict_memory``.
+    memory_guard_fraction: float = 0.85
+    strict_memory: bool = False
+    # chunked prefill ("auto" | int chunk | None): bounds per-layer prefill
+    # transients to O(batch x chunk) via the Pallas chunk kernel — the
+    # big-batch / long-prompt serving enabler (Transformer.prefill_chunked)
+    prefill_chunk_size: Optional[Any] = "auto"
 
     def model_post_init(self, _ctx):
         if self.mp_size is not None and self.tensor_parallel.tp_size == 1:

@@ -62,6 +62,8 @@ class InferenceEngine:
                 per_channel=self._config.quant.per_channel)
         self._params = None
         self._compiled = {}
+        self._workspace = KVCacheWorkspace(model)
+        self._aot = {}
         self._rng = jax.random.key(0)
         if params is not None:
             self.set_params(params)
@@ -230,9 +232,9 @@ class InferenceEngine:
     __call__ = forward
 
     def _get_generate(self, prompt_len, max_new_tokens, do_sample, temperature,
-                      top_k, top_p, with_mask=False):
+                      top_k, top_p, with_mask=False, prefill_chunk=None):
         key = ("gen", prompt_len, max_new_tokens, do_sample, temperature,
-               top_k, top_p, with_mask)
+               top_k, top_p, with_mask, prefill_chunk)
         if key in self._compiled:
             return self._compiled[key]
         # carry the quantized tree through the scan only when its dequant
@@ -243,8 +245,17 @@ class InferenceEngine:
             do_sample, temperature, top_k, top_p,
             param_transform=self._deq, with_mask=with_mask,
             carry_params=self._quantizer is not None
-            and self._quantizer.materializing_dequant)
+            and self._quantizer.materializing_dequant,
+            prefill_chunk=prefill_chunk)
         return self._compiled[key]
+
+    def _prefill_chunk_for(self, batch_size, prompt_len):
+        cfg = self._config.prefill_chunk_size
+        if cfg in (None, 0, "none", "off"):
+            return None
+        if cfg == "auto":
+            return default_prefill_chunk(batch_size, prompt_len)
+        return int(cfg) if int(cfg) < prompt_len else None
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=-1, seed=None,
@@ -265,14 +276,89 @@ class InferenceEngine:
         if seed is not None:
             self._rng = jax.random.key(seed)
         self._rng, rng = jax.random.split(self._rng)
+        chunk = self._prefill_chunk_for(input_ids.shape[0],
+                                        input_ids.shape[1])
         fn = self._get_generate(input_ids.shape[1], int(max_new_tokens),
                                 bool(do_sample), float(temperature), int(top_k),
                                 float(top_p),
-                                with_mask=attention_mask is not None)
-        args = (self._params, input_ids, rng, jnp.asarray(eos_token_id))
+                                with_mask=attention_mask is not None,
+                                prefill_chunk=chunk)
+        cache = self._workspace.take(
+            input_ids.shape[0],
+            required_cache_len(input_ids.shape[1], int(max_new_tokens),
+                               chunk),
+            self.compute_dtype)
+        args = (self._params, cache, input_ids, rng,
+                jnp.asarray(eos_token_id))
         if attention_mask is not None:
             args += (jnp.asarray(attention_mask),)
-        return fn(*args)
+        out, cache = self._run_guarded(fn, args)
+        self._workspace.give_back(cache)
+        return out
+
+    def release_workspace(self):
+        """Free the persistent KV-cache workspace buffer (reference
+        ``release_workspace``, ``inference_context.h``)."""
+        self._workspace.release()
+
+    def _run_guarded(self, fn, args):
+        """Compile-and-check-then-execute: the generation program is
+        AOT-compiled ONCE per argument signature (same executable the jit
+        path would build — donation included) and its
+        ``memory_analysis()`` is checked against ``memory_guard_fraction``
+        of device memory before the first execution.  Near the limit XLA
+        silently switches to staging buffers and decode collapses ~8x
+        (docs/performance.md, "measure the cliff"); the reference's
+        workspace allocator bounds-checks the same way
+        (``inference_context.h:24-87``)."""
+        sig = (id(fn),) + tuple((l.shape, str(l.dtype))
+                                for l in jax.tree.leaves(args))
+        compiled = self._aot.get(sig)
+        if compiled is None:
+            try:
+                compiled = fn.lower(*args).compile()
+            except Exception as e:
+                # AOT path is an optimization + guardrail; never let it
+                # block generation (fall back to the plain jit call)
+                logger.debug(f"AOT compile failed ({e}); jit fallback")
+                self._aot[sig] = fn
+                return fn(*args)
+            # guard BEFORE caching: under strict_memory every retry with
+            # the same over-budget signature must refuse again, not find
+            # a cached executable and run unguarded
+            self._guard_memory(compiled)
+            self._aot[sig] = compiled
+        return compiled(*args)
+
+    def _guard_memory(self, compiled):
+        import os
+        limit = int(os.environ.get("DSTPU_HBM_BYTES_OVERRIDE", "0"))
+        if not limit:
+            from deepspeed_tpu.profiling.flops_profiler.profiler import \
+                device_hbm_bytes
+            limit = device_hbm_bytes()
+        if not limit:
+            return                        # no budget info (CPU backend)
+        try:
+            ma = compiled.memory_analysis()
+            need = ma.temp_size_in_bytes + ma.argument_size_in_bytes
+        except Exception as e:            # introspection is best-effort
+            logger.debug(f"memory guardrail skipped: {e}")
+            return
+        frac = self._config.memory_guard_fraction
+        if need <= frac * limit:
+            return
+        msg = (f"generation program needs {need / 1e9:.1f} GB "
+               f"(args {ma.argument_size_in_bytes / 1e9:.1f} + temps "
+               f"{ma.temp_size_in_bytes / 1e9:.1f}) — above "
+               f"{frac:.0%} of device memory ({limit / 1e9:.1f} GB). "
+               f"XLA enters staging mode near this line and decode "
+               f"throughput collapses nonlinearly; use a smaller batch or "
+               f"shorter max cache (docs/performance.md, 'measure the "
+               f"cliff').")
+        if self._config.strict_memory:
+            raise RuntimeError(f"strict_memory: {msg}")
+        logger.warning(msg)
 
 
 def _unflatten_flax_paths(flat):
@@ -304,10 +390,94 @@ def require_right_padded(attention_mask):
                          "prompt) — drop it before generate()")
 
 
+class KVCacheWorkspace:
+    """Engine-owned persistent KV-cache buffer — the TPU analog of the
+    reference's reusable inference workspace
+    (``csrc/transformer/inference/includes/inference_context.h:24-87``:
+    allocate once, decode into it in place, reallocate only when the
+    requested shape changes).  The buffer is DONATED into each generation
+    program and reclaimed from its output, so the decode scan updates the
+    cache in place instead of entry-copying + double-buffering a fresh
+    zeros cache per call (measured ~2x-the-cache compiled temps before,
+    ~1x after — see docs/performance.md).
+
+    Stale contents are harmless by construction: every attention path masks
+    KV positions beyond each row's live length, so a reused buffer's old
+    tokens are never read.
+    """
+
+    def __init__(self, module):
+        self._module = module
+        self._key = None
+        self._cache = None
+
+    def take(self, batch_size, max_len, dtype):
+        """Hand out the workspace for a ``(B, max_len)`` generation; the
+        caller must ``give_back`` the program's output cache (the donated
+        input buffer is dead after the call)."""
+        key = (int(batch_size), int(max_len), jnp.dtype(dtype).name)
+        cache, self._cache = self._cache, None
+        if cache is None or self._key != key:
+            cache = None                    # drop the old buffer first
+            self._key = key
+            cache = self._module.init_cache(batch_size, max_len, dtype=dtype)
+        return cache
+
+    def give_back(self, cache):
+        self._cache = cache
+
+    def release(self):
+        """Free the workspace buffer (reference ``release_workspace``)."""
+        self._cache = None
+        self._key = None
+
+
+def auto_prefill_chunk(batch_size, prompt_len, token_budget=None):
+    """Pick the chunked-prefill chunk size (or None for one-pass prefill):
+    chunking pays when the prefill working set ``B x P`` is large enough
+    that per-layer transients crowd the KV cache out of HBM (measured
+    cliff: bs128 x 256 / bs16 x 4k OOM one-pass but run chunked).  The
+    chunk targets ``B x C <= token_budget`` (env
+    ``DSTPU_PREFILL_TOKEN_BUDGET``, default 16384 tokens), floored at 128
+    and capped at 512 (the kernel's VMEM accumulator bound)."""
+    import os
+    budget = int(token_budget
+                 or os.environ.get("DSTPU_PREFILL_TOKEN_BUDGET", "16384"))
+    if batch_size * prompt_len <= budget:
+        return None
+    c = 512
+    while c > 128 and batch_size * c > budget:
+        c //= 2
+    return c if c < prompt_len else None
+
+
+def default_prefill_chunk(batch_size, prompt_len):
+    """The shared chunk policy (serving + hybrid rollouts): auto chunk
+    sizing gated on kernel availability."""
+    from deepspeed_tpu.ops.transformer.flash_attention import pallas_supported
+    if not pallas_supported():
+        return None                      # chunk attention needs the kernel
+    return auto_prefill_chunk(batch_size, prompt_len)
+
+
+def required_cache_len(prompt_len, max_new_tokens, prefill_chunk):
+    """KV-workspace length for a generation: chunked prefill right-pads
+    the prompt to a chunk multiple and WRITES those pad positions, so the
+    cache must cover them — a shorter cache would let XLA clamp the last
+    chunk's dynamic_update_slice start and silently overwrite real prompt
+    K/V.  (Pad K/V beyond the live region are never read, and decode
+    overwrites position ``prompt_len + t`` before reading it.)"""
+    base = prompt_len + max_new_tokens
+    if prefill_chunk and prefill_chunk < prompt_len:
+        padded = -(-prompt_len // prefill_chunk) * prefill_chunk
+        return max(base, padded)
+    return base
+
+
 def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
                      do_sample, temperature, top_k, top_p,
                      param_transform=None, with_mask=False,
-                     carry_params=None):
+                     carry_params=None, prefill_chunk=None):
     """Build the jitted generation program: one-pass prefill + lax.scan
     decode loop with greedy / temperature / top-k / top-p sampling.  Shared
     by ``InferenceEngine`` and ``DeepSpeedHybridEngine``.
@@ -319,9 +489,17 @@ def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
     decode kernel's per-row length mask expects), while the returned array
     keeps the HF layout ``[prompt columns..., generated columns...]``.
 
-    Returns ``fn(params, input_ids, rng, eos_id[, attention_mask])
-    -> [B, prompt+new]``."""
-    max_len = prompt_len + max_new_tokens
+    The KV cache is an explicit, DONATED argument (allocate it with
+    ``module.init_cache``/``KVCacheWorkspace``): the donated buffer aliases
+    the output cache, so prefill writes and the decode scan's per-token
+    updates all land in one workspace buffer — no entry copy, no
+    double-buffered loop carry (the in-place workspace semantics of the
+    reference's ``inference_context.h``).
+
+    Returns ``fn(params, cache, input_ids, rng, eos_id[, attention_mask])
+    -> ([B, prompt+new], cache)``.  The cache must be at least
+    ``required_cache_len(prompt_len, max_new_tokens, prefill_chunk)``
+    positions long (chunked prefill writes the padded prompt tail)."""
 
     def sample_fn(logits, rng):
         logits = logits.astype(jnp.float32)
@@ -344,10 +522,9 @@ def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
     if carry_params is None:
         carry_params = param_transform is not None
 
-    def generate(params, input_ids, rng, eos_id, attention_mask=None):
+    def generate(params, cache, input_ids, rng, eos_id, attention_mask=None):
         deq = param_transform if param_transform is not None else (lambda p: p)
         B = input_ids.shape[0]
-        cache = module.init_cache(B, max_len, dtype=compute_dtype)
         # prefill the prompt in one pass (dequant fused into the prefill),
         # projecting ONLY each row's last real position through the vocab
         # head — full [B, prompt, V] prefill logits are a multi-GB
@@ -360,9 +537,17 @@ def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
         else:
             n = None
             last_pos = jnp.full((B,), prompt_len - 1, jnp.int32)
-        logits, cache = module.apply(deq(params), input_ids, cache, 0,
-                                     method=type(module).decode,
-                                     logits_at=last_pos)
+        if prefill_chunk and prefill_chunk < prompt_len:
+            # memory-bounded chunked prefill (see Transformer.
+            # prefill_chunked): per-layer transients are O(B*chunk), the
+            # enabler for big-batch and long-prompt serving points
+            logits, cache = module.apply(
+                deq(params), input_ids, cache, int(prefill_chunk),
+                method=type(module).prefill_chunked, logits_at=last_pos)
+        else:
+            logits, cache = module.apply(deq(params), input_ids, cache, 0,
+                                         method=type(module).decode,
+                                         logits_at=last_pos)
         rng, sub = jax.random.split(rng)
         last = logits[:, 0]
         if with_mask:
@@ -396,11 +581,12 @@ def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
             return (nxt, cache, pos + 1, rng, done, qparams), nxt
 
         done0 = (next_tok == eos_id)
-        (_, _, _, _, _, _), toks = jax.lax.scan(
+        (_, cache, _, _, _, _), toks = jax.lax.scan(
             step, (next_tok, cache, pos0, rng, done0,
                    params if carry_params else 0),
             None, length=max_new_tokens - 1)
         # HF contract: prompt + generated tokens
-        return jnp.concatenate([input_ids, next_tok[:, None], toks.T], axis=1)
+        out = jnp.concatenate([input_ids, next_tok[:, None], toks.T], axis=1)
+        return out, cache
 
-    return jax.jit(generate)
+    return jax.jit(generate, donate_argnums=(1,))

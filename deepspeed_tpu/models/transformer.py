@@ -429,6 +429,21 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
                                     v_scale=v_scale,
                                     window=window,
                                     int8_matmuls=int8_matmuls)[:, None]
+    if S > 1 and bias is None and window is None:
+        # multi-token block vs cache (chunked prefill / incremental
+        # multi-token feed): the chunk kernel keeps score tiles at
+        # [S, block_k] and never dequantizes the whole cache — the dense
+        # fallback below materializes [B, H, S, S_max] fp32 scores (and,
+        # quantized, a full-precision cache copy) per layer
+        from deepspeed_tpu.ops.transformer.decode_attention import (
+            chunk_prefill_attention)
+        from deepspeed_tpu.ops.transformer.flash_attention import (
+            pallas_supported)
+        if pallas_supported():
+            starts = q_positions[:, 0].astype(jnp.int32)
+            return chunk_prefill_attention(q, k_cache, v_cache, starts,
+                                           layer=layer, k_scale=k_scale,
+                                           v_scale=v_scale)
     if layer is not None:
         # dense fallback needs the layer slice after all
         sl = lambda c: jax.lax.dynamic_index_in_dim(c, layer, 0,
@@ -934,6 +949,49 @@ class Transformer(nn.Module):
             h = jnp.take_along_axis(
                 h, logits_at.astype(jnp.int32)[:, None, None], axis=1)
         return self._head(h), new_cache
+
+    def prefill_chunked(self, input_ids, cache, chunk_size, logits_at=None):
+        """Memory-bounded prefill: the prompt runs through the trunk in
+        ``chunk_size``-token blocks via an ``nn.scan`` over chunks (params
+        broadcast, cache carried), each chunk attending to the cache
+        through the Pallas chunk kernel — per-layer transients are
+        O(B·chunk) instead of O(B·prompt), which is what lets a 4k-prompt
+        or bs128 prefill fit next to the KV cache (reference analog: the
+        workspace-resident incremental prefill of ``inference_context.h``).
+
+        The prompt is right-padded to a chunk multiple; padded positions
+        write garbage K/V beyond the live region, which is safe: every
+        attention path masks positions beyond each query's own position,
+        and decode overwrites position ``prompt_len + t`` before reading
+        it.  Returns ``(logits, cache)`` like :meth:`decode` —
+        ``logits_at`` ([B] int32) selects the per-row positions projected
+        through the vocab head ([B, 1, V]); default is the last prompt
+        position.
+        """
+        cfg = self.config
+        B, P = input_ids.shape
+        C = int(chunk_size)
+        n = -(-P // C)
+        ids = jnp.pad(input_ids, ((0, 0), (0, n * C - P)))
+        chunks = ids.reshape(B, n, C).swapaxes(0, 1)          # [n, B, C]
+        starts = (jnp.arange(n) * C).astype(jnp.int32)
+
+        def _chunk_body(mdl, carry, xs):
+            start, chunk_ids = xs
+            h, new_cache = mdl.hidden_states(chunk_ids, cache=carry,
+                                             start_pos=start, train=False)
+            return _cache_data(new_cache), h
+
+        scanner = nn.scan(_chunk_body, variable_broadcast="params",
+                          split_rngs={"params": False, "dropout": False},
+                          in_axes=0, out_axes=0)
+        new_cache, hs = scanner(self, _cache_data(cache), (starts, chunks))
+        hs = hs.swapaxes(0, 1).reshape(B, n * C, -1)          # [B, P+pad, h]
+        if logits_at is None:
+            logits_at = jnp.full((B,), P - 1, jnp.int32)
+        h_last = jnp.take_along_axis(
+            hs, logits_at.astype(jnp.int32)[:, None, None], axis=1)
+        return self._head(h_last), new_cache
 
     def init_cache(self, batch_size, max_len, dtype=None):
         """Zero KV cache: [L, B, max_len, KVH*D] per k/v (layer-stacked for

@@ -176,6 +176,181 @@ def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
         o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
 
 
+def _chunk_prefill_kernel(start_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
+                          scale, block_k, nk, c, kvh, g, d, stacked, quant):
+    """Multi-token (chunk) prefill against the cache: rows ``iq`` of the
+    chunk attend causally to cache positions ``<= start_b + iq``.  Same
+    slab layout + online softmax as ``_decode_kernel``, but with a [C, bk]
+    score tile per head instead of the block-diagonal all-heads trick
+    (C×H rows would not fit one matmul)."""
+    if quant:
+        (ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr) = rest
+    else:
+        ks_ref = vs_ref = None
+        (o_ref, m_scr, l_scr, acc_scr) = rest
+    b = pl.program_id(0)
+    ik = pl.program_id(1)
+    h_total = kvh * g
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    start = start_ref[b]
+    limit = start + c                       # rows reach pos <= start+c-1
+    run = ik * block_k < limit
+
+    @pl.when(run)
+    def _body():
+        k = k_ref[0, 0] if stacked else k_ref[0]         # [bk, KVH*D]
+        v = v_ref[0, 0] if stacked else v_ref[0]
+        if quant:
+            k = k.astype(q_ref.dtype)
+            v = v.astype(q_ref.dtype)
+            kst = (ks_ref[0, 0] if stacked else ks_ref[0]) \
+                .astype(jnp.float32).T                   # [KVH, bk]
+            vst = (vs_ref[0, 0] if stacked else vs_ref[0]) \
+                .astype(jnp.float32).T
+        pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)                  # [1, bk]
+        qpos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (c, 1), 0)                        # [C, 1]
+        live = pos <= qpos                               # [C, bk] causal+tail
+        q_all = q_ref[0]                                 # [C, H*D]
+        for h in range(h_total):
+            hk = h // g
+            qh = q_all[:, h * d:(h + 1) * d]             # [C, D]
+            kh = k[:, hk * d:(hk + 1) * d]               # [bk, D]
+            s = jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if quant:
+                s = s * kst[hk:hk + 1]                   # [1, bk] k-scales
+            s = jnp.where(live, s, NEG_INF)
+            m_prev = m_scr[:, h:h + 1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            p = jnp.where(live, p, 0.0)
+            corr = jnp.exp(m_prev - m_new)
+            l_scr[:, h:h + 1] = (l_scr[:, h:h + 1] * corr
+                                 + jnp.sum(p, axis=1, keepdims=True))
+            m_scr[:, h:h + 1] = m_new
+            if quant:
+                p = p * vst[hk:hk + 1]                   # v-scales on P
+            o = jax.lax.dot_general(
+                p.astype(v.dtype), v[:, hk * d:(hk + 1) * d],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [C, D]
+            acc_scr[:, h * d:(h + 1) * d] = \
+                acc_scr[:, h * d:(h + 1) * d] * corr + o
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        for h in range(h_total):
+            l = l_scr[:, h:h + 1]
+            safe_l = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, :, h * d:(h + 1) * d] = \
+                (acc_scr[:, h * d:(h + 1) * d] / safe_l).astype(o_ref.dtype)
+
+
+def chunk_prefill_attention(q, k_cache, v_cache, starts, scale=None,
+                            block_k=DEFAULT_BLOCK_K_DECODE, layer=None,
+                            k_scale=None, v_scale=None):
+    """Chunked-prefill attention: a block of C fresh query tokens (already
+    written to the cache at positions ``starts[b] .. starts[b]+C-1``)
+    attends causally over the cache.  The memory-bounding half of chunked
+    prefill (reference analog: the workspace-resident incremental prefill
+    of ``inference_context.h`` + ``softmax_context``'s arbitrary-length
+    cache path, ``pt_binding.cpp:456``): score/probability tiles are
+    [C, block_k] regardless of prompt or cache length, so a 4k-prompt
+    prefill no longer materializes multi-GB per-layer transients.
+
+    q: [B, C, H, D]; caches as in :func:`decode_attention` (S-major slabs,
+    optionally layer-stacked + quantized).  starts: [B] int32 — each row's
+    chunk start position (cache positions beyond ``starts[b]+iq`` are
+    masked per query row ``iq``).  Returns [B, C, H, D].
+    """
+    B, C, H, D = q.shape
+    stacked = k_cache.ndim == 4
+    if stacked and layer is None:
+        raise ValueError("stacked [L, ...] caches require layer=")
+    quant = k_scale is not None
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
+    S_max, KVHD = k_cache.shape[-2], k_cache.shape[-1]
+    KVH = KVHD // D
+    G = H // KVH
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(D))
+    block_k = min(block_k, S_max)
+    nk = pl.cdiv(S_max, block_k)
+    layer_arr = jnp.asarray([layer if layer is not None else 0], jnp.int32)
+
+    def _live_block(ik, starts_arr, b):
+        # pin blocks past the chunk's furthest reachable position
+        # (starts[b] + C - 1) to the last live block — their DMA is elided
+        # and their compute pl.when-gated off, like decode's dead tail
+        last = jnp.maximum((starts_arr[b] + C + block_k - 1) // block_k - 1,
+                           0)
+        return jnp.minimum(ik, last)
+
+    if stacked:
+        kv_spec = pl.BlockSpec(
+            (1, 1, block_k, KVHD),
+            lambda b, ik, st, li: (li[0], b, _live_block(ik, st, b), 0))
+        sc_spec = pl.BlockSpec(
+            (1, 1, block_k, KVH),
+            lambda b, ik, st, li: (li[0], b, _live_block(ik, st, b), 0))
+    else:
+        kv_spec = pl.BlockSpec(
+            (1, block_k, KVHD),
+            lambda b, ik, st, li: (b, _live_block(ik, st, b), 0))
+        sc_spec = pl.BlockSpec(
+            (1, block_k, KVH),
+            lambda b, ik, st, li: (b, _live_block(ik, st, b), 0))
+
+    in_specs = [
+        # q flattened to [B, C, H*D] — Mosaic blocks want at most two
+        # non-unit trailing dims, and the flat layout matches the cache
+        # slabs' full-lane-width tiling anyway
+        pl.BlockSpec((1, C, H * D), lambda b, ik, st, li: (b, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    operands = [q.reshape(B, C, H * D), k_cache, v_cache]
+    if quant:
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale, v_scale]
+
+    out = pl.pallas_call(
+        functools.partial(_chunk_prefill_kernel, scale=float(scale),
+                          block_k=block_k, nk=nk, c=C, kvh=KVH, g=G, d=D,
+                          stacked=stacked, quant=quant),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, nk),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, C, H * D),
+                                   lambda b, ik, st, li: (b, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((C, H), jnp.float32),         # running max
+                pltpu.VMEM((C, H), jnp.float32),         # running sum
+                pltpu.VMEM((C, H * D), jnp.float32),     # per-head acc
+            ]),
+        out_shape=jax.ShapeDtypeStruct((B, C, H * D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=max(
+                64 * 1024 * 1024,
+                4 * block_k * KVHD * q.dtype.itemsize
+                + 2 * C * H * D * 4 + 16 * 1024 * 1024)),
+        interpret=_interpret(),
+    )(jnp.asarray(starts, jnp.int32), layer_arr, *operands)
+    return out.reshape(B, C, H, D)
+
+
 def decode_attention(q, k_cache, v_cache, lengths,
                      scale=None, block_k=DEFAULT_BLOCK_K_DECODE, layer=None,
                      k_scale=None, v_scale=None, window=None,

@@ -469,9 +469,19 @@ class DeepSpeedEngine:
                     return scaled, (loss, aux)
 
                 grads, (loss, aux) = jax.grad(loss_of, has_aux=True)(params)
-                # fp32 grad accumulation even when working params are 16-bit
-                # (offload path; reference stage_1_and_2.py fp32 accum)
-                grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+                # grad accumulation dtype: fp32 by default even when working
+                # params are 16-bit (offload path; reference
+                # stage_1_and_2.py fp32 accum); ``data_types.
+                # grad_accum_dtype: "bf16"`` halves the accumulator — the
+                # enabler for 2.7B-class offload on a 16 GB chip, at the
+                # documented cost of bf16 addition noise across the
+                # accumulation window (reference data_types knob)
+                acc_dt = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                          "fp16": jnp.float16, "float16": jnp.float16,
+                          "fp32": jnp.float32, "float32": jnp.float32}.get(
+                    self._config.gradient_accumulation_dtype or "fp32",
+                    jnp.float32)
+                grads = jax.tree.map(lambda g: g.astype(acc_dt), grads)
                 flat = jax.tree.leaves(grads)
                 found_inf = jnp.logical_not(
                     jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in flat])))

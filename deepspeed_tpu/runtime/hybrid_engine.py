@@ -94,6 +94,11 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                 self._infer_params is not None:
             self._infer_params = None
             self._infer_params_step = -1
+        # the rollout KV-cache workspace is likewise its own HBM
+        # allocation (GBs at serving batch sizes) — release it before the
+        # train step's activation peak; the next rollout re-zeros it once
+        if getattr(self, "_gen_workspace", None) is not None:
+            self._gen_workspace.release()
 
     def train_batch(self, *args, **kwargs):
         self._drop_quantized_view()
@@ -249,8 +254,11 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         """Rollout generation over the shared weights.  ``attention_mask``
         supports RIGHT-padded prompt batches — the usual RLHF rollout input
         (see ``InferenceEngine.generate`` for the layout contract)."""
-        from deepspeed_tpu.inference.engine import (make_generate_fn,
-                                                    require_right_padded)
+        from deepspeed_tpu.inference.engine import (KVCacheWorkspace,
+                                                    default_prefill_chunk,
+                                                    make_generate_fn,
+                                                    require_right_padded,
+                                                    required_cache_len)
         import time
         t0 = time.time()
         input_ids = jnp.asarray(input_ids)
@@ -259,9 +267,10 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         if seed is not None:
             self._gen_rng = jax.random.key(seed)
         self._gen_rng, rng = jax.random.split(self._gen_rng)
+        chunk = default_prefill_chunk(input_ids.shape[0], input_ids.shape[1])
         key = (input_ids.shape[1], int(max_new_tokens), bool(do_sample),
                float(temperature), int(top_k), float(top_p),
-               attention_mask is not None)
+               attention_mask is not None, chunk)
         if key not in self._gen_compiled:
             # carry the rollout view through the decode scan only when its
             # dequant materializes full weights (see WeightQuantization
@@ -274,12 +283,23 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                 param_transform=self._rollout_deq,
                 with_mask=attention_mask is not None,
                 carry_params=self._rollout_quantizer is not None
-                and self._rollout_quantizer.materializing_dequant)
+                and self._rollout_quantizer.materializing_dequant,
+                prefill_chunk=chunk)
         params = self._inference_view()
-        args = (params, input_ids, rng, jnp.asarray(eos_token_id))
+        if getattr(self, "_gen_workspace", None) is None:
+            # donated KV-cache workspace, shared across rollouts (see
+            # KVCacheWorkspace: in-place decode, no double-buffered carry)
+            self._gen_workspace = KVCacheWorkspace(self.module)
+        cache = self._gen_workspace.take(
+            input_ids.shape[0],
+            required_cache_len(input_ids.shape[1], int(max_new_tokens),
+                               chunk),
+            self.compute_dtype)
+        args = (params, cache, input_ids, rng, jnp.asarray(eos_token_id))
         if attention_mask is not None:
             args += (jnp.asarray(attention_mask),)
-        out = self._gen_compiled[key](*args)
+        out, cache = self._gen_compiled[key](*args)
+        self._gen_workspace.give_back(cache)
         out.block_until_ready()
         self._generate_latency += time.time() - t0
         return out

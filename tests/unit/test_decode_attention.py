@@ -279,3 +279,76 @@ def test_decode_short_lengths_exact():
                 ref = p @ np.asarray(v[b, h])
                 np.testing.assert_allclose(got[b, h], ref, rtol=2e-5,
                                            atol=2e-5)
+
+
+# --------------------------------------------------------------------- #
+# chunk_prefill_attention — the chunked-prefill kernel
+# --------------------------------------------------------------------- #
+
+from deepspeed_tpu.ops.transformer.decode_attention import \
+    chunk_prefill_attention
+
+
+@pytest.mark.parametrize("kvh", [8, 2])   # MHA + GQA
+@pytest.mark.parametrize("start", [0, 24])
+def test_chunk_prefill_matches_cached_attention(kvh, start):
+    """A C-token chunk at offset ``start`` must match the dense cached
+    path (causal within the chunk + full attention to the prefix)."""
+    B, H, D, S_max, C = 2, 8, 16, 64, 16
+    rng = np.random.default_rng(start * 10 + kvh)
+    q = jnp.asarray(rng.standard_normal((B, C, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, kvh, S_max, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, kvh, S_max, D)), jnp.float32)
+    ks, vs = to_smajor(k), to_smajor(v)
+    pos = start + jnp.broadcast_to(jnp.arange(C), (B, C))
+    want = np.asarray(xla_cached_attention(q, ks, vs, pos.astype(jnp.int32)))
+    got = np.asarray(chunk_prefill_attention(
+        q, ks, vs, jnp.full((B,), start, jnp.int32)))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_chunk_prefill_blocked_and_per_row_starts():
+    """Multi-block cache + per-row starts: each row's chunk begins at its
+    own offset (padded-prompt chunked prefill)."""
+    B, H, D, S_max, C = 2, 4, 8, 256, 32
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((B, C, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, S_max, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, S_max, D)), jnp.float32)
+    ks, vs = to_smajor(k), to_smajor(v)
+    starts = jnp.asarray([64, 128], jnp.int32)
+    got = np.asarray(chunk_prefill_attention(q, ks, vs, starts, block_k=64))
+    for b in range(B):
+        pos = (int(starts[b]) + jnp.arange(C))[None].astype(jnp.int32)
+        want = np.asarray(xla_cached_attention(
+            q[b:b + 1], ks[b:b + 1], vs[b:b + 1], pos))[0]
+        np.testing.assert_allclose(got[b], want, rtol=2e-4, atol=2e-4)
+
+
+def test_chunk_prefill_stacked_int8():
+    """Layer-stacked int8 cache through the chunk kernel == dense math on
+    the dequantized payload."""
+    rng = np.random.default_rng(3)
+    L, B, KVH, S_max, D, H, C = 2, 2, 4, 96, 16, 8, 16
+    k = rng.standard_normal((L, B, KVH, S_max, D)) * 3.0
+    v = rng.standard_normal((L, B, KVH, S_max, D))
+    ks = to_smajor(jnp.asarray(k, jnp.float32))
+    vs = to_smajor(jnp.asarray(v, jnp.float32))
+    kq, ksc = quantize_smajor(ks, KVH)
+    vq, vsc = quantize_smajor(vs, KVH)
+    q = jnp.asarray(rng.standard_normal((B, C, H, D)), jnp.float32)
+    starts = jnp.asarray([32, 5], jnp.int32)
+    for li in range(L):
+        got = np.asarray(chunk_prefill_attention(
+            q, kq, vq, starts, block_k=32, layer=jnp.asarray(li),
+            k_scale=ksc, v_scale=vsc))
+        kdq = (np.asarray(kq[li], np.float32).reshape(B, S_max, KVH, D)
+               * np.asarray(ksc[li])[..., None]).reshape(B, S_max, KVH * D)
+        vdq = (np.asarray(vq[li], np.float32).reshape(B, S_max, KVH, D)
+               * np.asarray(vsc[li])[..., None]).reshape(B, S_max, KVH * D)
+        for b in range(B):
+            pos = (int(starts[b]) + jnp.arange(C))[None].astype(jnp.int32)
+            want = np.asarray(xla_cached_attention(
+                q[b:b + 1], jnp.asarray(kdq[b:b + 1]),
+                jnp.asarray(vdq[b:b + 1]), pos))[0]
+            np.testing.assert_allclose(got[b], want, rtol=2e-4, atol=2e-4)

@@ -61,7 +61,9 @@ def test_carry_params_variants_agree(model_and_params):
     for carry in (False, True):
         fn = make_generate_fn(model, jnp.float32, ids.shape[1], 8,
                               False, 1.0, 0, 1.0, carry_params=carry)
-        outs.append(np.asarray(fn(params, ids, rng, -1)))
+        cache = model.init_cache(ids.shape[0], ids.shape[1] + 8,
+                                 dtype=jnp.float32)
+        outs.append(np.asarray(fn(params, cache, ids, rng, -1)[0]))
     np.testing.assert_array_equal(outs[0], outs[1])
     # and the masked (padded-prompt) variant, sampled, both ways
     mask = np.ones(ids.shape, np.int32)
@@ -71,8 +73,10 @@ def test_carry_params_variants_agree(model_and_params):
         fn = make_generate_fn(model, jnp.float32, ids.shape[1], 8,
                               True, 0.8, 0, 0.9, with_mask=True,
                               carry_params=carry)
-        outs.append(np.asarray(fn(params, ids, rng, -1,
-                                  jnp.asarray(mask))))
+        cache = model.init_cache(ids.shape[0], ids.shape[1] + 8,
+                                 dtype=jnp.float32)
+        outs.append(np.asarray(fn(params, cache, ids, rng, -1,
+                                  jnp.asarray(mask))[0]))
     np.testing.assert_array_equal(outs[0], outs[1])
 
 
@@ -338,3 +342,128 @@ def test_left_padded_mask_rejected(model_and_params):
     mask[0, :3] = 0                      # left padding on row 0
     with pytest.raises(ValueError, match="RIGHT-padded"):
         eng.generate(ids, max_new_tokens=2, attention_mask=mask)
+
+
+def test_chunked_prefill_matches_one_pass(model_and_params):
+    """Chunked prefill (nn.scan over chunks + the Pallas chunk kernel)
+    must generate the same greedy tokens as the one-pass flash prefill —
+    including a chunk size that does not divide the prompt."""
+    from deepspeed_tpu.inference.engine import make_generate_fn
+    model, params, ids = model_and_params              # prompt len 12
+    rng = jax.random.key(3)
+    outs = {}
+    for chunk in (None, 4, 5):
+        fn = make_generate_fn(model, jnp.float32, ids.shape[1], 6,
+                              False, 1.0, 0, 1.0, prefill_chunk=chunk)
+        cache = model.init_cache(ids.shape[0], ids.shape[1] + 6,
+                                 dtype=jnp.float32)
+        outs[chunk] = np.asarray(fn(params, cache, ids, rng, -1)[0])
+    np.testing.assert_allclose(outs[4], outs[None], atol=0, rtol=0)
+    np.testing.assert_allclose(outs[5], outs[None], atol=0, rtol=0)
+
+
+def test_chunked_prefill_int8_kv(model_and_params):
+    """Chunked prefill over the int8 KV cache: same quantized ints land in
+    the cache as the one-pass path writes, so greedy tokens agree."""
+    from deepspeed_tpu.inference.engine import make_generate_fn
+    model0, params, ids = model_and_params
+    model = Transformer(tiny_cfg(kv_cache_quant=True))
+    rng = jax.random.key(3)
+    outs = {}
+    for chunk in (None, 4):
+        fn = make_generate_fn(model, jnp.float32, ids.shape[1], 6,
+                              False, 1.0, 0, 1.0, prefill_chunk=chunk)
+        cache = model.init_cache(ids.shape[0], ids.shape[1] + 6,
+                                 dtype=jnp.float32)
+        outs[chunk] = np.asarray(fn(params, cache, ids, rng, -1)[0])
+    np.testing.assert_array_equal(outs[4], outs[None])
+
+
+def test_auto_prefill_chunk_policy():
+    from deepspeed_tpu.inference.engine import auto_prefill_chunk
+    assert auto_prefill_chunk(64, 256) is None          # fits the budget
+    assert auto_prefill_chunk(128, 256) == 128          # bs128 serving point
+    assert auto_prefill_chunk(16, 3968) == 512          # 4k long-cache point
+    assert auto_prefill_chunk(1, 512) is None           # tiny batch
+
+
+def test_serving_memory_guardrail(model_and_params, monkeypatch, caplog):
+    """Compile-time serving guardrail: a program whose argument+temp bytes
+    exceed ``memory_guard_fraction`` of the device budget warns — and
+    refuses under ``strict_memory`` (reference analog: workspace bounds
+    checks in ``inference_context.h``)."""
+    from deepspeed_tpu.inference import engine as eng_mod
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    model, params, ids = model_and_params
+    # a deliberately tiny "device": everything is over-threshold
+    monkeypatch.setenv("DSTPU_HBM_BYTES_OVERRIDE", "1000")
+    warned = []
+    monkeypatch.setattr(eng_mod.logger, "warning",
+                        lambda msg, *a, **k: warned.append(str(msg)))
+    eng = InferenceEngine(model, DeepSpeedInferenceConfig(dtype="float32"),
+                          params=params)
+    out = eng.generate(ids, max_new_tokens=4)          # warns, still runs
+    assert out.shape == (2, 16)
+    assert any("above" in m and "device memory" in m for m in warned), warned
+    strict = InferenceEngine(
+        model, DeepSpeedInferenceConfig(dtype="float32", strict_memory=True),
+        params=params)
+    with pytest.raises(RuntimeError, match="strict_memory"):
+        strict.generate(ids, max_new_tokens=8)
+    # a sane budget passes silently
+    monkeypatch.setenv("DSTPU_HBM_BYTES_OVERRIDE", str(10 ** 12))
+    ok = InferenceEngine(
+        model, DeepSpeedInferenceConfig(dtype="float32", strict_memory=True),
+        params=params)
+    assert ok.generate(ids, max_new_tokens=4).shape == (2, 16)
+
+
+def test_kv_workspace_reuse_and_release(model_and_params):
+    """The engine-owned KV workspace is donated and reused across calls
+    (same shape -> same buffer lineage), reallocated on shape change, and
+    freed by release_workspace()."""
+    model, params, ids = model_and_params
+    engine = deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+    engine.set_params(params)
+    out1 = engine.generate(ids, max_new_tokens=8)
+    ws = engine._workspace
+    assert ws._cache is not None             # reclaimed from the program
+    k1 = ws._key
+    out2 = engine.generate(ids, max_new_tokens=8)    # same shape: reuse
+    assert ws._key == k1
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    engine.generate(ids, max_new_tokens=4)           # shape change: realloc
+    assert ws._key != k1
+    engine.release_workspace()
+    assert ws._cache is None and ws._key is None
+
+
+def test_chunked_prefill_pad_overflow(model_and_params):
+    """P % C != 0 with max_new_tokens smaller than the pad: the padded
+    last chunk writes past prompt+new, so the workspace must be sized by
+    required_cache_len — a clamped write would silently corrupt real
+    prompt K/V (regression: review finding on transformer.prefill_chunked)."""
+    from deepspeed_tpu.inference.engine import (make_generate_fn,
+                                                required_cache_len)
+    model, params, ids = model_and_params          # prompt len 12
+    rng = jax.random.key(5)
+    new = 2                                        # 12+2=14 < padded 15
+    assert required_cache_len(12, new, 5) == 15
+    ref_fn = make_generate_fn(model, jnp.float32, 12, new,
+                              False, 1.0, 0, 1.0, prefill_chunk=None)
+    cache = model.init_cache(2, required_cache_len(12, new, None),
+                             dtype=jnp.float32)
+    want = np.asarray(ref_fn(params, cache, ids, rng, -1)[0])
+    fn = make_generate_fn(model, jnp.float32, 12, new,
+                          False, 1.0, 0, 1.0, prefill_chunk=5)
+    cache = model.init_cache(2, required_cache_len(12, new, 5),
+                             dtype=jnp.float32)
+    got = np.asarray(fn(params, cache, ids, rng, -1)[0])
+    np.testing.assert_array_equal(got, want)
+    # and through the public engine path with a forced chunk
+    engine = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32", "prefill_chunk_size": 5})
+    engine.set_params(params)
+    out = np.asarray(engine.generate(ids, max_new_tokens=new))
+    np.testing.assert_array_equal(out, want)

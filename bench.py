@@ -157,10 +157,30 @@ def calibrate_bench():
     dt = timed_loop(mm, a, 4 if on_cpu else 8)
     measured_tflops = 2 * m ** 3 / dt / 1e12
 
+    # --- host<->device link (the offload tier's speed limit) ---
+    h = np.ones((1 << 27,), np.uint8)              # 128 MB
+    x = jax.device_put(h); x.block_until_ready()   # warm path + alloc
+    up, down = [], []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        x = jax.device_put(h); x.block_until_ready()
+        up.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        _ = np.asarray(jax.device_get(x))
+        down.append(time.perf_counter() - t0)
+    link_up = h.nbytes / min(up) / 1e9
+    link_down = h.nbytes / min(down) / 1e9
+
     const_tflops, const_gbps = device_peak_tflops(), device_peak_hbm_gbps()
     return {
         "platform": jax.devices()[0].platform,
         "n_devices": jax.device_count(),
+        # host link: what ZeRO-Offload's per-boundary grad-down/param-up
+        # round trip can at best achieve on THIS host path (tunneled
+        # devices are far below PCIe — the honest denominator for the
+        # offload phase's overhead)
+        "host_to_device_gbps": round(link_up, 2),
+        "device_to_host_gbps": round(link_down, 2),
         "measured_hbm_gbps": round(measured_gbps, 1),
         "measured_mxu_tflops": round(measured_tflops, 1),
         "datasheet_hbm_gbps": const_gbps,
@@ -174,7 +194,16 @@ def calibrate_bench():
 
 def train_bench(model_name, *, micro_bs, zero_stage, steps, seq=2048,
                 lean=False, remat=False, remat_policy="dots_and_attn_saveable",
-                scan_layers=False, fused_qkv=False, loss_chunks=8):
+                scan_layers=False, fused_qkv=False, loss_chunks=8,
+                gas=1, offload=None, grad_accum_dtype=None):
+    """``offload``: None (in-HBM optimizer) | "cpu" (ZeRO-Offload: bf16
+    working params on device, fp32 masters+moments in host RAM, the C++
+    SIMD Adam steps them) | "nvme" (moments/masters in swap files through
+    ``csrc/aio``, pipelined reads).  ``gas`` amortizes the per-optimizer-
+    step host round-trip over gradient-accumulation micro-steps —
+    large-model single-chip training exactly as the reference stages it
+    (stage_1_and_2.py:1037 offload path; blogs/deepspeed-chat README
+    OPT-13B-on-one-A100 story)."""
     import jax
     import deepspeed_tpu
     from deepspeed_tpu.models.opt import opt_config
@@ -189,22 +218,28 @@ def train_bench(model_name, *, micro_bs, zero_stage, steps, seq=2048,
     opt_params = {"lr": 9.65e-6, "weight_decay": 0.0}
     if lean:
         opt_params["state_dtype"] = "bfloat16"
-    engine, *_ = deepspeed_tpu.initialize(
-        model=model,
-        config={
-            "train_micro_batch_size_per_gpu": micro_bs,
-            "gradient_accumulation_steps": 1,
-            "optimizer": {"type": "AdamW", "params": opt_params},
-            "bf16": {"enabled": True, "master_weights_in_bf16": bool(lean)},
-            "zero_optimization": {"stage": zero_stage},
-            "gradient_clipping": 1.0,
-        })
+    config = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "AdamW", "params": opt_params},
+        "bf16": {"enabled": True, "master_weights_in_bf16": bool(lean)},
+        "zero_optimization": {"stage": zero_stage},
+        "gradient_clipping": 1.0,
+    }
+    if offload:
+        config["zero_optimization"]["offload_optimizer"] = {
+            "device": offload, "pipeline_read": offload == "nvme",
+            **({"nvme_path": "/tmp/dstpu_bench_nvme"}
+               if offload == "nvme" else {})}
+    if grad_accum_dtype:
+        config["data_types"] = {"grad_accum_dtype": grad_accum_dtype}
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=config)
 
     rng = np.random.default_rng(0)
     n_dev = jax.device_count()
     batch = {"input_ids": rng.integers(
         0, cfg.vocab_size,
-        (1, micro_bs * engine.topology.dp, seq)).astype(np.int32)}
+        (gas, micro_bs * engine.topology.dp, seq)).astype(np.int32)}
 
     loss = engine.train_batch(batch=batch)
     loss = engine.train_batch(batch=batch)
@@ -216,7 +251,7 @@ def train_bench(model_name, *, micro_bs, zero_stage, steps, seq=2048,
     final_loss = _sync_scalar(loss)
     dt = (time.perf_counter() - t0) / steps
 
-    tokens_per_step = micro_bs * engine.topology.dp * seq
+    tokens_per_step = micro_bs * engine.topology.dp * seq * gas
     n_params = cfg.num_params()
     peak = device_peak_tflops() * 1e12 * n_dev
     mfu = 6.0 * n_params * tokens_per_step / dt / peak if peak else 0.0
@@ -233,6 +268,12 @@ def train_bench(model_name, *, micro_bs, zero_stage, steps, seq=2048,
         "remat": bool(remat),
         "platform": jax.devices()[0].platform,
     }
+    if gas != 1:
+        result["gradient_accumulation_steps"] = gas
+    if offload:
+        result["offload_optimizer"] = offload
+    if grad_accum_dtype:
+        result["grad_accum_dtype"] = grad_accum_dtype
     meas_tflops, _ = _measured_peaks()
     if meas_tflops:
         result["mfu_vs_measured_mxu"] = round(
@@ -365,9 +406,9 @@ def long_context_bench(model_name="opt-1.3b", *, seq=8192, micro_bs=1,
     return r
 
 
-def hybrid_bench(model_name="opt-1.3b", *, train_bs=2, rollout_bs=8,
+def hybrid_bench(model_name="opt-1.3b", *, train_bs=2, rollout_bs=(8, 32, 64),
                  prompt=256, gen=128, seq=2048, cycles=2, train_steps=4,
-                 remat=False):
+                 remat=True, quantize_rollouts=True):
     """DS-Chat step-3 RLHF loop at OPT-1.3B scale through the Hybrid Engine
     (reference ``runtime/hybrid_engine.py:32``; headline rows in
     ``blogs/deepspeed-chat/README.md:38,52``): N ZeRO-3 train steps → rollout
@@ -388,12 +429,13 @@ def hybrid_bench(model_name="opt-1.3b", *, train_bs=2, rollout_bs=8,
     from deepspeed_tpu.models.opt import opt_config
     from deepspeed_tpu.models.transformer import Transformer
 
-    # remat OFF by default, like the north-star phase: even with the decode
-    # program resident, lean states leave room for full activations at bs2
-    # (r3 probe: 0.364 s/step vs 0.393 with remat); the OOM-fallback retry
-    # flips remat back on
+    # remat ON by default here: the int8 rollout view + its KV cache are
+    # resident during training's activation peak at the larger rollout
+    # batches (the no-remat + int8-view combination OOMs at 1.3B —
+    # r3 probe); the fallback drops to the bf16 view at bs8
     cfg = opt_config(model_name, max_seq_len=seq, dtype="bfloat16",
-                     remat=remat, scan_layers=False, loss_seq_chunks=8)
+                     remat=remat, scan_layers=False, loss_seq_chunks=8,
+                     kv_cache_quant=quantize_rollouts)
     model = Transformer(cfg)
     engine, *_ = deepspeed_tpu.initialize(
         model=model,
@@ -406,19 +448,29 @@ def hybrid_bench(model_name="opt-1.3b", *, train_bs=2, rollout_bs=8,
             "bf16": {"enabled": True, "master_weights_in_bf16": True},
             "zero_optimization": {"stage": 3},
             "gradient_clipping": 1.0,
-            "hybrid_engine": {"enabled": True},
+            # int8-at-rest rollout view + int8 KV cache: rollouts are the
+            # Hybrid Engine's whole point (reference blog: "up to 9x vs
+            # HF") and decode is HBM-bound — serve them like the
+            # inference engine serves (reference runtime/hybrid_engine.py
+            # :178 generate; quantized view is this framework's extension)
+            "hybrid_engine": {"enabled": True,
+                              "quantize_rollouts": bool(quantize_rollouts)},
         })
     rng = np.random.default_rng(0)
     batch = {"input_ids": rng.integers(
         0, cfg.vocab_size,
         (1, train_bs * engine.topology.dp, seq)).astype(np.int32)}
-    prompts = rng.integers(0, cfg.vocab_size,
-                           (rollout_bs, prompt)).astype(np.int32)
+    if isinstance(rollout_bs, int):
+        rollout_bs = (rollout_bs,)
+    prompt_sets = {bs: rng.integers(0, cfg.vocab_size,
+                                    (bs, prompt)).astype(np.int32)
+                   for bs in rollout_bs}
 
     # warm both compiled programs (train step + rollout decode)
     _sync_scalar(engine.train_batch(batch=batch))
-    out = engine.generate(prompts, max_new_tokens=gen)
-    _sync_scalar(out[:, -1])
+    for bs in rollout_bs:
+        out = engine.generate(prompt_sets[bs], max_new_tokens=gen)
+        _sync_scalar(out[:, -1])
 
     def timed_train(n):
         t0 = time.perf_counter()
@@ -428,14 +480,15 @@ def hybrid_bench(model_name="opt-1.3b", *, train_bs=2, rollout_bs=8,
         return (time.perf_counter() - t0) / n
 
     train_before = timed_train(train_steps)
-    rollout_times = []
+    rollout_times = {bs: [] for bs in rollout_bs}
     train_after = None
     for _ in range(cycles):
-        t0 = time.perf_counter()
-        out = engine.generate(prompts, max_new_tokens=gen, do_sample=True,
-                              temperature=1.0, top_p=0.9)
-        _sync_scalar(out[:, -1])
-        rollout_times.append(time.perf_counter() - t0)
+        for bs in rollout_bs:
+            t0 = time.perf_counter()
+            out = engine.generate(prompt_sets[bs], max_new_tokens=gen,
+                                  do_sample=True, temperature=1.0, top_p=0.9)
+            _sync_scalar(out[:, -1])
+            rollout_times[bs].append(time.perf_counter() - t0)
         train_after = timed_train(train_steps)
 
     # weight identity over the FULL pytree, reduced on device to one
@@ -452,11 +505,18 @@ def hybrid_bench(model_name="opt-1.3b", *, train_bs=2, rollout_bs=8,
         return jnp.all(jnp.stack(checks))
 
     masters = engine._params
+    # the identity contract is about the UNQUANTIZED shared-weight view
+    # (the reference Hybrid Engine premise); flip quantization off for the
+    # check, back on after
+    if quantize_rollouts:
+        engine.set_rollout_quantization(bits=0)
     views = engine._inference_view()
     n_leaves = len(jax.tree.leaves(masters))
     assert n_leaves == len(jax.tree.leaves(views))
     identical = bool(jax.device_get(
         jax.jit(_tree_identical)(masters, views)))
+    if quantize_rollouts:
+        engine.set_rollout_quantization(bits=8)
 
     # int8 rollout-view spot check: round-trip the LARGEST matmul weight
     # through the same per-channel quantizer quantize_rollouts uses
@@ -474,24 +534,67 @@ def hybrid_bench(model_name="opt-1.3b", *, train_bs=2, rollout_bs=8,
     # channel max; channel maxes <= global max, so global-max/127 bounds it
     int8_roundtrip_ok = err <= scale / 127.0 + 1e-6
 
-    rollout_t = min(rollout_times)
-    return {
+    per_bs = {bs: min(ts) for bs, ts in rollout_times.items()}
+    best_bs = max(per_bs, key=lambda bs: bs * gen / per_bs[bs])
+    result = {
         "model": model_name,
         "zero_stage": 3,
         "train_step_s_before_rollout": round(train_before, 4),
         "train_step_s_after_rollout": round(train_after, 4),
+        "rollout_quant": "int8+int8kv" if quantize_rollouts else "bf16",
         "rollout_tokens_per_sec_chip": round(
-            rollout_bs * gen / rollout_t / jax.device_count(), 1),
-        "rollout_bs": rollout_bs,
+            best_bs * gen / per_bs[best_bs] / jax.device_count(), 1),
+        "rollout_bs": best_bs,
+        "rollout_sweep_tokens_per_sec_chip": {
+            str(bs): round(bs * gen / t / jax.device_count(), 1)
+            for bs, t in per_bs.items()},
         "prompt_len": prompt,
         "gen_len": gen,
-        "rollout_time_s": round(rollout_t, 3),
+        "rollout_time_s": round(per_bs[best_bs], 3),
         "weights_shared_identical": identical,
         "weights_checked_leaves": n_leaves,
         "int8_view_roundtrip_ok": bool(int8_roundtrip_ok),
         "int8_view_max_abs_err": round(err, 6),
         "remat": bool(remat),
         "cycles": cycles,
+    }
+    return result
+
+
+def offload_bench(model_name="opt-350m", *, micro_bs=4, steps=3, gas=4):
+    """Measured ZeRO-Offload tier (reference ``stage_1_and_2.py:1037``
+    CPU-offload + ``swap_tensor/`` NVMe, perf harness
+    ``csrc/aio/py_test/``): the SAME workload in-HBM, host-offloaded
+    (C++ SIMD Adam over host-resident fp32 masters/moments), and
+    NVMe-swapped (pipelined ``csrc/aio`` reads behind the Adam compute).
+    Reports step times and the offload overhead factor — honest even when
+    ugly: through a tunneled host link the round trip dominates, which is
+    exactly what the calibration phase's link numbers predict."""
+    base = train_bench(model_name, micro_bs=micro_bs, zero_stage=2,
+                       steps=steps, gas=gas)
+    cpu = train_bench(model_name, micro_bs=micro_bs, zero_stage=2,
+                      steps=steps, gas=gas, offload="cpu")
+    nvme = train_bench(model_name, micro_bs=micro_bs, zero_stage=2,
+                       steps=steps, gas=gas, offload="nvme")
+    return {
+        "model": model_name,
+        "gradient_accumulation_steps": gas,
+        "in_hbm_step_s": base["step_time_s"],
+        "cpu_offload_step_s": cpu["step_time_s"],
+        "nvme_offload_step_s": nvme["step_time_s"],
+        "cpu_offload_overhead_x": round(
+            cpu["step_time_s"] / base["step_time_s"], 2),
+        "nvme_offload_overhead_x": round(
+            nvme["step_time_s"] / base["step_time_s"], 2),
+        # the NVMe leg's own cost on top of host offload = the swap
+        # read/write not hidden behind the pipelined Adam
+        "nvme_vs_cpu_x": round(
+            nvme["step_time_s"] / cpu["step_time_s"], 2),
+        "in_hbm_tokens_per_sec_chip": base["tokens_per_sec_chip"],
+        "cpu_offload_tokens_per_sec_chip": cpu["tokens_per_sec_chip"],
+        "nvme_offload_tokens_per_sec_chip": nvme["tokens_per_sec_chip"],
+        "loss_in_hbm": base["loss"],
+        "loss_cpu_offload": cpu["loss"],
     }
 
 
@@ -542,11 +645,34 @@ def _guard(fallback):
                        remat=bool(fallback))
 
 
+def _sft27(fallback):
+    """OPT-2.7B on ONE 16 GB chip: bf16 working params + bf16 grad
+    accumulation on device (~10.8 GB), fp32 masters + Adam moments in
+    host RAM stepped by the C++ SIMD Adam, with gradient accumulation
+    amortizing the per-boundary host round trip — the reference's
+    single-GPU large-model recipe (blogs/deepspeed-chat README:64-66,
+    OPT-13B on one A100-80G via offload)."""
+    return train_bench("opt-2.7b", micro_bs=1, zero_stage=2,
+                       steps=2 if fallback else 3,
+                       gas=8 if fallback else 16,
+                       remat=True,
+                       remat_policy="flash_only_saveable" if fallback
+                       else "dots_and_attn_saveable",
+                       offload="cpu", grad_accum_dtype="bf16",
+                       loss_chunks=8)
+
+
 PHASES = [
     # (key in result, phase name, runner(fallback) -> dict)
     ("calibration", "calibrate", lambda fb: calibrate_bench()),
     ("__headline__", "north", _north),
     ("sft_350m_guard", "guard", _guard),
+    # single-chip large-model story: 2.7B via ZeRO-Offload (see _sft27)
+    ("sft_2.7b", "sft_2.7b", _sft27),
+    # the offload/NVMe tier, measured against the same in-HBM workload
+    ("optimizer_offload", "offload",
+     lambda fb: offload_bench(gas=2 if fb else 4,
+                              steps=2 if fb else 3)),
     ("generation", "decode",
      lambda fb: decode_bench("opt-1.3b", batch_size=8 if fb else 16)),
     ("generation_int8", "decode_int8",
@@ -578,7 +704,9 @@ PHASES = [
                              batch_size=8 if fb else 16,
                              prompt=3968, gen=128)),
     ("hybrid_rlhf", "hybrid",
-     lambda fb: hybrid_bench("opt-1.3b", remat=bool(fb))),
+     lambda fb: hybrid_bench("opt-1.3b",
+                             rollout_bs=(8,) if fb else (8, 32, 64),
+                             quantize_rollouts=not fb)),
     ("long_context", "long_context",
      lambda fb: long_context_bench("opt-1.3b", seq=4096 if fb else 8192)),
 ]

@@ -232,9 +232,10 @@ class InferenceEngine:
     __call__ = forward
 
     def _get_generate(self, prompt_len, max_new_tokens, do_sample, temperature,
-                      top_k, top_p, with_mask=False, prefill_chunk=None):
+                      top_k, top_p, with_mask=False, prefill_chunk=None,
+                      external_prefill=False):
         key = ("gen", prompt_len, max_new_tokens, do_sample, temperature,
-               top_k, top_p, with_mask, prefill_chunk)
+               top_k, top_p, with_mask, prefill_chunk, external_prefill)
         if key in self._compiled:
             return self._compiled[key]
         # carry the quantized tree through the scan only when its dequant
@@ -246,7 +247,7 @@ class InferenceEngine:
             param_transform=self._deq, with_mask=with_mask,
             carry_params=self._quantizer is not None
             and self._quantizer.materializing_dequant,
-            prefill_chunk=prefill_chunk)
+            prefill_chunk=prefill_chunk, external_prefill=external_prefill)
         return self._compiled[key]
 
     def _prefill_chunk_for(self, batch_size, prompt_len):
@@ -276,24 +277,91 @@ class InferenceEngine:
         if seed is not None:
             self._rng = jax.random.key(seed)
         self._rng, rng = jax.random.split(self._rng)
-        chunk = self._prefill_chunk_for(input_ids.shape[0],
-                                        input_ids.shape[1])
-        fn = self._get_generate(input_ids.shape[1], int(max_new_tokens),
+        B, P = input_ids.shape
+        chunk = self._prefill_chunk_for(B, P)
+        n_chunks = -(-P // chunk) if chunk else 1
+        if n_chunks > 2:
+            # many chunks: run prefill as REPEATED CALLS of one per-chunk
+            # executable instead of an in-program scan — the scan's
+            # while-loop carries a partial extra copy of the cache that
+            # XLA will not alias away (measured ~2.8 GB at a 4k cache),
+            # and per-call the donated cache aliases straight through, so
+            # peak memory is max(chunk program, decode program), not
+            # their union.  Costs one dispatch per chunk.
+            return self._generate_split(
+                input_ids, int(max_new_tokens), bool(do_sample),
+                float(temperature), int(top_k), float(top_p),
+                eos_token_id, rng, attention_mask, chunk)
+        fn = self._get_generate(P, int(max_new_tokens),
                                 bool(do_sample), float(temperature), int(top_k),
                                 float(top_p),
                                 with_mask=attention_mask is not None,
                                 prefill_chunk=chunk)
         cache = self._workspace.take(
-            input_ids.shape[0],
-            required_cache_len(input_ids.shape[1], int(max_new_tokens),
-                               chunk),
+            B, required_cache_len(P, int(max_new_tokens), chunk),
             self.compute_dtype)
-        args = (self._params, cache, input_ids, rng,
-                jnp.asarray(eos_token_id))
+        try:
+            args = (self._params, cache, input_ids, rng,
+                    jnp.asarray(eos_token_id))
+            if attention_mask is not None:
+                args += (jnp.asarray(attention_mask),)
+            out, cache = self._run_guarded(fn, args)
+        finally:
+            # on failure the (possibly donated-and-dead) buffer still goes
+            # back; take() checks liveness before reuse
+            self._workspace.give_back(cache)
+        return out
+
+    def _generate_split(self, input_ids, max_new_tokens, do_sample,
+                        temperature, top_k, top_p, eos_token_id, rng,
+                        attention_mask, chunk):
+        """Split-prefill generation: one donated-cache per-chunk prefill
+        executable (chunk start and per-row logits positions are traced
+        ARGUMENTS, so all chunks replay the same program) followed by the
+        decode-only program.  See generate() for when this path wins."""
+        B, P = input_ids.shape
+        C = int(chunk)
+        n = -(-P // C)
+        cache = self._workspace.take(
+            B, required_cache_len(P, max_new_tokens, C), self.compute_dtype)
+        ck = ("chunkfill", C, B)
+        if ck not in self._compiled:
+            module, deq = self.module, self._deq
+
+            def chunk_step(params, cache, chunk_ids, start, logits_at):
+                return module.apply(deq(params), chunk_ids, cache, start,
+                                    method=type(module).decode,
+                                    logits_at=logits_at)
+            self._compiled[ck] = jax.jit(chunk_step, donate_argnums=(1,))
+        chunk_fn = self._compiled[ck]
+        ids_pad = jnp.pad(input_ids, ((0, 0), (0, n * C - P)))
         if attention_mask is not None:
-            args += (jnp.asarray(attention_mask),)
-        out, cache = self._run_guarded(fn, args)
-        self._workspace.give_back(cache)
+            last = jnp.sum(attention_mask.astype(jnp.int32), axis=1) - 1
+        else:
+            last = jnp.full((B,), P - 1, jnp.int32)
+        try:
+            sel = None
+            for ci in range(n):
+                local = jnp.clip(last - ci * C, 0, C - 1)
+                logits, cache = self._run_guarded(
+                    chunk_fn,
+                    (self._params, cache, ids_pad[:, ci * C:(ci + 1) * C],
+                     jnp.asarray(ci * C, jnp.int32), local))
+                in_chunk = ((last // C) == ci)[:, None, None]
+                sel = logits if sel is None \
+                    else jnp.where(in_chunk, logits, sel)
+            fn = self._get_generate(P, max_new_tokens, do_sample, temperature,
+                                    top_k, top_p,
+                                    with_mask=attention_mask is not None,
+                                    external_prefill=True)
+            args = (self._params, cache, input_ids, rng,
+                    jnp.asarray(eos_token_id))
+            args += ((jnp.asarray(attention_mask),)
+                     if attention_mask is not None else (None,))
+            args += (sel,)
+            out, cache = self._run_guarded(fn, args)
+        finally:
+            self._workspace.give_back(cache)
         return out
 
     def release_workspace(self):
@@ -417,6 +485,13 @@ class KVCacheWorkspace:
         input buffer is dead after the call)."""
         key = (int(batch_size), int(max_len), jnp.dtype(dtype).name)
         cache, self._cache = self._cache, None
+        if cache is not None and any(
+                getattr(l, "is_deleted", lambda: False)()
+                for l in jax.tree.leaves(cache)):
+            # a generation program that failed AFTER donation leaves the
+            # given-back buffers dead — reallocate instead of handing a
+            # deleted array to the next program
+            cache = None
         if cache is None or self._key != key:
             cache = None                    # drop the old buffer first
             self._key = key
@@ -470,14 +545,18 @@ def required_cache_len(prompt_len, max_new_tokens, prefill_chunk):
     base = prompt_len + max_new_tokens
     if prefill_chunk and prefill_chunk < prompt_len:
         padded = -(-prompt_len // prefill_chunk) * prefill_chunk
-        return max(base, padded)
-    return base
+        base = max(base, padded)
+    # multiple of 8: the fused decode kernel's write-stripe outputs are
+    # 8-sublane-aligned blocks (positions beyond prompt+new are never
+    # attended — length-masked like any unwritten tail)
+    return -(-base // 8) * 8
 
 
 def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
                      do_sample, temperature, top_k, top_p,
                      param_transform=None, with_mask=False,
-                     carry_params=None, prefill_chunk=None):
+                     carry_params=None, prefill_chunk=None,
+                     external_prefill=False):
     """Build the jitted generation program: one-pass prefill + lax.scan
     decode loop with greedy / temperature / top-k / top-p sampling.  Shared
     by ``InferenceEngine`` and ``DeepSpeedHybridEngine``.
@@ -496,10 +575,13 @@ def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
     double-buffered loop carry (the in-place workspace semantics of the
     reference's ``inference_context.h``).
 
-    Returns ``fn(params, cache, input_ids, rng, eos_id[, attention_mask])
-    -> ([B, prompt+new], cache)``.  The cache must be at least
-    ``required_cache_len(prompt_len, max_new_tokens, prefill_chunk)``
-    positions long (chunked prefill writes the padded prompt tail)."""
+    Returns ``fn(params, cache, input_ids, rng, eos_id[, attention_mask,
+    prefill_logits]) -> ([B, prompt+new], cache)``.  The cache must be at
+    least ``required_cache_len(prompt_len, max_new_tokens, prefill_chunk)``
+    positions long (chunked prefill writes the padded prompt tail).
+    ``external_prefill=True`` builds the decode-only program: the caller
+    prefilled the cache already (engine split-prefill path) and passes the
+    last-position ``prefill_logits`` [B, 1, V]."""
 
     def sample_fn(logits, rng):
         logits = logits.astype(jnp.float32)
@@ -522,9 +604,23 @@ def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
     if carry_params is None:
         carry_params = param_transform is not None
 
-    def generate(params, cache, input_ids, rng, eos_id, attention_mask=None):
+    def generate(params, cache, input_ids, rng, eos_id,
+                 attention_mask=None, prefill_logits=None):
         deq = param_transform if param_transform is not None else (lambda p: p)
         B = input_ids.shape[0]
+        # static guard: an undersized cache would let XLA CLAMP the padded
+        # last chunk's write start, silently overwriting real prompt K/V
+        min_len = prompt_len + max_new_tokens
+        if prefill_chunk and prefill_chunk < prompt_len \
+                and not external_prefill:
+            min_len = max(min_len,
+                          -(-prompt_len // prefill_chunk) * prefill_chunk)
+        if cache["k"].shape[-2] < min_len:
+            raise ValueError(
+                f"KV cache has {cache['k'].shape[-2]} positions but this "
+                f"generation needs >= {min_len} (prompt {prompt_len} + new "
+                f"{max_new_tokens}, chunked-prefill pad included) — size "
+                f"it with required_cache_len()")
         # prefill the prompt in one pass (dequant fused into the prefill),
         # projecting ONLY each row's last real position through the vocab
         # head — full [B, prompt, V] prefill logits are a multi-GB
@@ -537,7 +633,12 @@ def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
         else:
             n = None
             last_pos = jnp.full((B,), prompt_len - 1, jnp.int32)
-        if prefill_chunk and prefill_chunk < prompt_len:
+        if external_prefill:
+            # the caller ran prefill (engine split-prefill path) and hands
+            # in the last-position logits; the cache already holds the
+            # prompt's K/V
+            logits = prefill_logits
+        elif prefill_chunk and prefill_chunk < prompt_len:
             # memory-bounded chunked prefill (see Transformer.
             # prefill_chunked): per-layer transients are O(B*chunk), the
             # enabler for big-batch and long-prompt serving points

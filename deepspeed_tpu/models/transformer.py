@@ -429,12 +429,15 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
                                     v_scale=v_scale,
                                     window=window,
                                     int8_matmuls=int8_matmuls)[:, None]
-    if S > 1 and bias is None and window is None:
+    if 1 < S <= 512 and bias is None and window is None:
         # multi-token block vs cache (chunked prefill / incremental
         # multi-token feed): the chunk kernel keeps score tiles at
         # [S, block_k] and never dequantizes the whole cache — the dense
         # fallback below materializes [B, H, S, S_max] fp32 scores (and,
-        # quantized, a full-precision cache copy) per layer
+        # quantized, a full-precision cache copy) per layer.  S is capped
+        # at 512: the kernel's q block and f32 accumulator scale with
+        # S x H x D and would blow VMEM on longer blocks — those keep the
+        # dense HBM fallback.
         from deepspeed_tpu.ops.transformer.decode_attention import (
             chunk_prefill_attention)
         from deepspeed_tpu.ops.transformer.flash_attention import (
@@ -492,6 +495,54 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
     logits = jnp.where(ok, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhst,bhtd->bshd", probs, v_cache)
+
+
+def _fused_decode_step(cfg, q, k, v, positions, cache, bias, window, S_):
+    """Single-token decode through the FUSED-WRITE kernel: the kernel
+    writes this step's K/V row (quantizing when the cache is int8) into
+    the caches via aliased outputs AND attends — no out-of-kernel
+    dynamic_update_slice on the multi-GB cache at all.  Returns
+    ``(out [B,1,H,D], new_cache)`` or None when this step must take the
+    write-then-attend path (multi-token, alibi bias, the opt-in int8-MXU
+    mode, or no Pallas).
+
+    Why this exists: the DUS chain interleaved with the kernel's cache
+    reads makes XLA copy the cache per step once it exceeds ~2.2 GB
+    (measured 129 ms/step vs 12.7 fused at bs16 x 4k x 24 layers) — the
+    in-place write the reference gets from its workspace pointer
+    arithmetic (``inference_context.h:24-87``) has to live INSIDE the
+    kernel here."""
+    if S_ != 1 or bias is not None or cfg.decode_int8_matmuls:
+        return None
+    if cache["k"].shape[-2] % 8 != 0:
+        # the write-stripe outputs are 8-sublane-aligned blocks; odd cache
+        # lengths (hand-allocated test caches) take the unfused path
+        # (required_cache_len rounds engine workspaces to a multiple of 8)
+        return None
+    from deepspeed_tpu.ops.transformer.decode_attention import (
+        decode_attention)
+    from deepspeed_tpu.ops.transformer.flash_attention import (
+        pallas_supported)
+    if not pallas_supported():
+        return None
+    lengths = (positions[:, 0] + 1).astype(jnp.int32)
+    res = decode_attention(q[:, 0], cache["k"], cache["v"], lengths,
+                           layer=cache.get("layer"),
+                           k_scale=cache.get("k_scale"),
+                           v_scale=cache.get("v_scale"),
+                           window=window,
+                           new_k=k[:, 0], new_v=v[:, 0])
+    if cfg.kv_cache_quant:
+        out_f, kc, vc, ksc, vsc = res
+        data = {"k": kc, "v": vc, "k_scale": ksc, "v_scale": vsc}
+    else:
+        out_f, kc, vc = res
+        data = {"k": kc, "v": vc}
+    new_cache = dict(
+        data,
+        **({"layer": cache["layer"]} if "layer" in cache else {}),
+        **({"per_row": cache["per_row"]} if "per_row" in cache else {}))
+    return out_f[:, None], new_cache
 
 
 class Attention(nn.Module):
@@ -553,6 +604,15 @@ class Attention(nn.Module):
             # swaps.  (Alibi models keep the dense path: their bias is
             # sized to the cache, not the prompt.)
             prefill_from_zero = bool(prefill) and S_ > 1 and bias is None
+            fused = _fused_decode_step(cfg, q, k, v, positions, cache,
+                                       bias, window, S_)
+            if fused is not None:
+                out, new_cache = fused
+                proj = dense(features=cfg.hidden_size, axis=(-2, -1),
+                             use_bias=cfg.attn_out_bias_enabled,
+                             name="o_proj")(
+                    out.reshape(*out.shape[:2], H, D))
+                return proj, new_cache
             k_new = k.reshape(B_, S_, KVH * D)
             v_new = v.reshape(B_, S_, KVH * D)
             ks_new = vs_new = None
@@ -975,22 +1035,32 @@ class Transformer(nn.Module):
         ids = jnp.pad(input_ids, ((0, 0), (0, n * C - P)))
         chunks = ids.reshape(B, n, C).swapaxes(0, 1)          # [n, B, C]
         starts = (jnp.arange(n) * C).astype(jnp.int32)
+        if logits_at is None:
+            logits_at = jnp.full((B,), P - 1, jnp.int32)
+        logits_at = logits_at.astype(jnp.int32)
 
+        # each chunk selects its rows' requested hidden vectors and merges
+        # them into a [B, 1, hidden] carry — stacking every chunk's full
+        # hidden states as scan outputs would reintroduce the O(B x P x h)
+        # transient this method exists to avoid
         def _chunk_body(mdl, carry, xs):
+            cache, h_sel = carry
             start, chunk_ids = xs
-            h, new_cache = mdl.hidden_states(chunk_ids, cache=carry,
+            h, new_cache = mdl.hidden_states(chunk_ids, cache=cache,
                                              start_pos=start, train=False)
-            return _cache_data(new_cache), h
+            local = jnp.clip(logits_at - start, 0, C - 1)
+            h_c = jnp.take_along_axis(h, local[:, None, None], axis=1)
+            in_chunk = ((logits_at >= start)
+                        & (logits_at < start + C))[:, None, None]
+            return (_cache_data(new_cache),
+                    jnp.where(in_chunk, h_c, h_sel)), ()
 
         scanner = nn.scan(_chunk_body, variable_broadcast="params",
                           split_rngs={"params": False, "dropout": False},
                           in_axes=0, out_axes=0)
-        new_cache, hs = scanner(self, _cache_data(cache), (starts, chunks))
-        hs = hs.swapaxes(0, 1).reshape(B, n * C, -1)          # [B, P+pad, h]
-        if logits_at is None:
-            logits_at = jnp.full((B,), P - 1, jnp.int32)
-        h_last = jnp.take_along_axis(
-            hs, logits_at.astype(jnp.int32)[:, None, None], axis=1)
+        h0 = jnp.zeros((B, 1, cfg.hidden_size), cfg.jnp_dtype)
+        (new_cache, h_last), _ = scanner(self, (_cache_data(cache), h0),
+                                         (starts, chunks))
         return self._head(h_last), new_cache
 
     def init_cache(self, batch_size, max_len, dtype=None):

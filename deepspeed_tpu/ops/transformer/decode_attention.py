@@ -53,8 +53,20 @@ DEFAULT_BLOCK_K_DECODE = int(_os.environ.get("DSTPU_DECODE_BLOCK_K", "512"))
 
 def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
                    scale, block_k, nk, kvh, g, d, stacked, quant, window,
-                   mxu_int8):
-    if quant and mxu_int8:
+                   mxu_int8, fused_write=False):
+    if fused_write:
+        # in-kernel cache write (see decode_attention new_k/new_v): the
+        # new token's raw K/V rows ride extra inputs and the caches come
+        # BACK as aliased outputs pinned at each row's write block
+        if quant:
+            (ks_ref, vs_ref, kn_ref, vn_ref, o_ref, ko_ref, vo_ref,
+             kso_ref, vso_ref, m_scr, l_scr, acc_scr, qbd_scr) = rest
+            qs_scr = None
+        else:
+            ks_ref = vs_ref = kso_ref = vso_ref = qs_scr = None
+            (kn_ref, vn_ref, o_ref, ko_ref, vo_ref,
+             m_scr, l_scr, acc_scr, qbd_scr) = rest
+    elif quant and mxu_int8:
         (ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr, qbd_scr,
          qs_scr) = rest
     elif quant:
@@ -63,6 +75,23 @@ def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
     else:
         ks_ref = vs_ref = qs_scr = None
         (o_ref, m_scr, l_scr, acc_scr, qbd_scr) = rest
+
+    def _new_rows():
+        """This step's K/V rows, quantized the same way the cache stores
+        them (payload+scale when ``quant``), plus the DEQUANTIZED values
+        this step's attention must see — write-then-read parity with the
+        unfused path."""
+        kn = kn_ref[0].astype(jnp.float32)               # [KVH, D]
+        vn = vn_ref[0].astype(jnp.float32)
+        if not quant:
+            return kn, vn, kn, vn, None, None
+        ks_n = jnp.max(jnp.abs(kn), axis=1, keepdims=True) / 127.0
+        vs_n = jnp.max(jnp.abs(vn), axis=1, keepdims=True) / 127.0
+        ks_safe = jnp.where(ks_n == 0.0, 1.0, ks_n)
+        vs_safe = jnp.where(vs_n == 0.0, 1.0, vs_n)
+        kq = jnp.clip(jnp.round(kn / ks_safe), -127, 127)
+        vq = jnp.clip(jnp.round(vn / vs_safe), -127, 127)
+        return kq, vq, kq * ks_safe, vq * vs_safe, ks_safe, vs_safe
     b = pl.program_id(0)
     ik = pl.program_id(1)
 
@@ -137,6 +166,19 @@ def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
             # at position length-1, so the live window is
             # [length - window, length)
             live = jnp.logical_and(live, pos >= length - window)
+        if fused_write:
+            # the cache does NOT yet hold this step's token: its column
+            # (global position length-1, which only occurs in this — the
+            # last live — block) is recomputed from the fresh row and
+            # substituted into the score tile.  Dequantized values keep
+            # write-then-read parity with the unfused path.
+            _, _, kn_used, vn_used, _, _ = _new_rows()
+            kn_rep = kn_used if g == 1 else jnp.repeat(kn_used, g, axis=0)
+            q_f32 = q_ref[0].astype(jnp.float32)         # [H, D]
+            col = jnp.sum(q_f32 * kn_rep, axis=1,
+                          keepdims=True) * scale         # [H, 1]
+            sel_col = (pos == length - 1)                # [1, bk]
+            s = jnp.where(sel_col, col, s)
         s = jnp.where(live, s, NEG_INF)                  # [H, bk]
         m_prev = m_scr[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -148,6 +190,16 @@ def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
             l_scr.shape)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         pv = p * _expand_scales(vs_ref) if quant else p
+        if fused_write:
+            # the V slab's row at the write column is stale too: zero that
+            # probability column for the big PV matmul and add its rank-1
+            # contribution from the fresh (dequantized) V row per head.
+            # p_col comes from the RAW probabilities — the fresh row's
+            # scale is already folded into vn_used, the slab's stale
+            # v-scale must not touch it.
+            p_col = jnp.sum(jnp.where(sel_col, p, 0.0), axis=1,
+                            keepdims=True)               # [H, 1]
+            pv = jnp.where(sel_col, 0.0, pv)
         if mxu_int8:
             # fold the v-scale into P, then quantize P per row: the PV
             # matmul also runs int8×int8 with a per-row rescale after
@@ -166,14 +218,75 @@ def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
         # accumulate each head's D-column diagonal block of [H, KVH*D]
         for h in range(kvh):
             rows = slice(h * g, (h + 1) * g)
-            acc_scr[rows] = (acc_scr[rows] * corr[rows]
-                             + o_flat[rows, h * d:(h + 1) * d])
+            contrib = o_flat[rows, h * d:(h + 1) * d]
+            if fused_write:
+                contrib = contrib + p_col[rows] * vn_used[h:h + 1]
+            acc_scr[rows] = acc_scr[rows] * corr[rows] + contrib
 
     @pl.when(ik == nk - 1)
     def _finish():
         l = l_scr[:, 0:1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        if fused_write:
+            # write this step's row into the cache via the ALIASED,
+            # 8-ROW-STRIPE outputs: the output blocks cover only the
+            # 8-sublane-aligned stripe containing the write row (pinned
+            # by index map), so per step the flush is 8 rows — not a
+            # whole block (a full-block write-back measured ~1.8x on the
+            # whole decode step at bs64).  The stripe's other 7 rows are
+            # merged from the raw input slab (loaded for scores anyway);
+            # Mosaic accepts the dynamic 8-aligned ref read.
+            row = (length - 1) % block_k
+            base = (row // 8) * 8
+            off = row - base
+            sel = jax.lax.broadcasted_iota(
+                jnp.int32, (8, 1), 0) == off             # [8, 1]
+            kq, vq, _, _, ks_n, vs_n = _new_rows()
+            if stacked:
+                kraw8 = k_ref[0, 0, pl.dslice(base, 8)]  # [8, KVH*D] raw
+                vraw8 = v_ref[0, 0, pl.dslice(base, 8)]
+            else:
+                kraw8 = k_ref[0, pl.dslice(base, 8)]
+                vraw8 = v_ref[0, pl.dslice(base, 8)]
+            # per-kv-head merges: Mosaic cannot shape-cast a computed
+            # [KVH, D] f32 tile to [1, KVH*D], so each head's D-column
+            # stripe merges separately
+            for hk in range(kvh):
+                cols = slice(hk * d, (hk + 1) * d)
+                km = jnp.where(sel, kq[hk:hk + 1],
+                               kraw8[:, cols].astype(jnp.float32))
+                vm = jnp.where(sel, vq[hk:hk + 1],
+                               vraw8[:, cols].astype(jnp.float32))
+                if stacked:
+                    ko_ref[0, 0, :, cols] = km.astype(ko_ref.dtype)
+                    vo_ref[0, 0, :, cols] = vm.astype(vo_ref.dtype)
+                else:
+                    ko_ref[0, :, cols] = km.astype(ko_ref.dtype)
+                    vo_ref[0, :, cols] = vm.astype(vo_ref.dtype)
+            if quant:
+                if stacked:
+                    ks_raw8 = ks_ref[0, 0, pl.dslice(base, 8)] \
+                        .astype(jnp.float32)             # [8, KVH]
+                    vs_raw8 = vs_ref[0, 0, pl.dslice(base, 8)] \
+                        .astype(jnp.float32)
+                else:
+                    ks_raw8 = ks_ref[0, pl.dslice(base, 8)] \
+                        .astype(jnp.float32)
+                    vs_raw8 = vs_ref[0, pl.dslice(base, 8)] \
+                        .astype(jnp.float32)
+                lane = jax.lax.broadcasted_iota(jnp.int32, (1, kvh), 1)
+                ksm, vsm = ks_raw8, vs_raw8
+                for hk in range(kvh):
+                    m = jnp.logical_and(sel, lane == hk)  # [8, KVH]
+                    ksm = jnp.where(m, ks_n[hk, 0], ksm)
+                    vsm = jnp.where(m, vs_n[hk, 0], vsm)
+                if stacked:
+                    kso_ref[0, 0] = ksm.astype(kso_ref.dtype)
+                    vso_ref[0, 0] = vsm.astype(vso_ref.dtype)
+                else:
+                    kso_ref[0] = ksm.astype(kso_ref.dtype)
+                    vso_ref[0] = vsm.astype(vso_ref.dtype)
 
 
 def _chunk_prefill_kernel(start_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
@@ -354,7 +467,7 @@ def chunk_prefill_attention(q, k_cache, v_cache, starts, scale=None,
 def decode_attention(q, k_cache, v_cache, lengths,
                      scale=None, block_k=DEFAULT_BLOCK_K_DECODE, layer=None,
                      k_scale=None, v_scale=None, window=None,
-                     int8_matmuls=False):
+                     int8_matmuls=False, new_k=None, new_v=None):
     """Single-token decode attention.
 
     q: [B, H, D] (this step's query); caches: [B, S_max, KVH*D]
@@ -373,6 +486,20 @@ def decode_attention(q, k_cache, v_cache, lengths,
     cache-dominated share of the step.  Dequantization never touches the
     [block_k, KVH*D] slabs — the k-scale lands on the score tile and the
     v-scale on the probability tile (both [H, block_k]).
+
+    ``new_k``/``new_v`` ([B, KVH, D], raw projection rows) switch on the
+    FUSED CACHE WRITE: the kernel quantizes (when the cache is int8) and
+    writes this step's row at each row's position ``lengths[b]-1`` into
+    the caches, returned as ALIASED outputs (``input_output_aliases`` —
+    the in-place workspace write of the reference's ``inference_context``)
+    — and substitutes the fresh row into this step's own attention.  The
+    caller must then NOT pre-write the cache.  Returns
+    ``(out, k_cache, v_cache[, k_scale, v_scale])`` instead of ``out``.
+    Measured: the out-of-kernel dynamic-update-slice chain interacting
+    with the kernel's cache reads makes XLA copy the multi-GB cache
+    per step above ~bs12 x 4k (129 ms/step); the fused write runs at
+    kernel-only speed (12.7 ms/step at bs16 x 4k x 24 layers).
+    ``int8_matmuls`` is unsupported with the fused write.
     """
     B, H, D = q.shape
     stacked = k_cache.ndim == 4
@@ -384,6 +511,17 @@ def decode_attention(q, k_cache, v_cache, lengths,
     if int8_matmuls and not quant:
         raise ValueError("int8_matmuls requires quantized caches "
                          "(k_scale/v_scale)")
+    fused_write = new_k is not None
+    if (new_k is None) != (new_v is None):
+        raise ValueError("new_k and new_v must be given together")
+    if fused_write and int8_matmuls:
+        raise ValueError("int8_matmuls is unsupported with the fused "
+                         "cache write (new_k/new_v)")
+    if fused_write and k_cache.shape[-2] % 8 != 0:
+        raise ValueError(
+            f"fused cache write needs S_max % 8 == 0 (8-sublane-aligned "
+            f"write stripes); got {k_cache.shape[-2]} — round the cache "
+            f"length up (required_cache_len does)")
     mxu_int8 = bool(int8_matmuls)
     S_max, KVHD = k_cache.shape[-2], k_cache.shape[-1]
     KVH = KVHD // D
@@ -432,18 +570,58 @@ def decode_attention(q, k_cache, v_cache, lengths,
         in_specs += [sc_spec, sc_spec]
         operands += [k_scale, v_scale]
 
-    out = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, H, D), lambda b, ik, lens, li: (b, 0, 0))]
+    out_shape = [jax.ShapeDtypeStruct((B, H, D), q.dtype)]
+    io_aliases = {}
+    if fused_write:
+        # pinned write-STRIPE output specs: the output block is only the
+        # 8-sublane-aligned stripe containing each row's write position
+        # (block index in 8-row units), constant per batch row, so Mosaic
+        # flushes 8 rows once after the final (writing) grid step;
+        # input_output_aliases makes the returned caches the SAME buffers
+        # the caller passed in (no copy, no extra HBM)
+        def _write_stripe(lens, b):
+            return jnp.maximum(lens[b] - 1, 0) // 8
+
+        if stacked:
+            kvo_spec = pl.BlockSpec(
+                (1, 1, 8, KVHD),
+                lambda b, ik, lens, li: (li[0], b, _write_stripe(lens, b), 0))
+            sco_spec = pl.BlockSpec(
+                (1, 1, 8, KVH),
+                lambda b, ik, lens, li: (li[0], b, _write_stripe(lens, b), 0))
+        else:
+            kvo_spec = pl.BlockSpec(
+                (1, 8, KVHD),
+                lambda b, ik, lens, li: (b, _write_stripe(lens, b), 0))
+            sco_spec = pl.BlockSpec(
+                (1, 8, KVH),
+                lambda b, ik, lens, li: (b, _write_stripe(lens, b), 0))
+        nspec = pl.BlockSpec((1, KVH, D), lambda b, ik, lens, li: (b, 0, 0))
+        in_specs += [nspec, nspec]
+        operands += [new_k, new_v]
+        out_specs += [kvo_spec, kvo_spec]
+        out_shape += [jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+                      jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype)]
+        # operand indices INCLUDE the two scalar-prefetch args
+        io_aliases = {3: 1, 4: 2}
+        if quant:
+            out_specs += [sco_spec, sco_spec]
+            out_shape += [jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+                          jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype)]
+            io_aliases = {3: 1, 4: 2, 5: 3, 6: 4}
+
+    res = pl.pallas_call(
         functools.partial(_decode_kernel, scale=float(scale),
                           block_k=block_k, nk=nk, kvh=KVH, g=G, d=D,
                           stacked=stacked, quant=quant,
                           window=None if window is None else int(window),
-                          mxu_int8=mxu_int8),
+                          mxu_int8=mxu_int8, fused_write=fused_write),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(B, nk),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((1, H, D),
-                                   lambda b, ik, lens, li: (b, 0, 0)),
+            out_specs=out_specs if fused_write else out_specs[0],
             scratch_shapes=[
                 pltpu.VMEM((H, LSE_LANES), jnp.float32),
                 pltpu.VMEM((H, LSE_LANES), jnp.float32),
@@ -452,17 +630,18 @@ def decode_attention(q, k_cache, v_cache, lengths,
                            jnp.int8 if mxu_int8 else q.dtype),
             ] + ([pltpu.VMEM((H, LSE_LANES), jnp.float32)]
                  if mxu_int8 else [])),
-        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        out_shape=out_shape if fused_write else out_shape[0],
+        input_output_aliases=io_aliases,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
             # the [block_k, KVH*D] K/V slabs double-buffer; the default
             # 16 MB scoped-vmem budget is a hair short at the default
             # block_k, and DSTPU_DECODE_BLOCK_K can grow the slabs further —
             # size the budget from the actual blocks (4 slab buffers +
-            # scratch/q/out headroom)
+            # write-block outputs + scratch/q/out headroom)
             vmem_limit_bytes=max(
-                64 * 1024 * 1024,
-                4 * block_k * KVHD * q.dtype.itemsize + 8 * 1024 * 1024)),
+                96 * 1024 * 1024,
+                6 * block_k * KVHD * q.dtype.itemsize + 16 * 1024 * 1024)),
         interpret=_interpret(),
     )(jnp.asarray(lengths, jnp.int32), layer_arr, *operands)
-    return out
+    return res

@@ -476,12 +476,15 @@ class DeepSpeedEngine:
                 # enabler for 2.7B-class offload on a 16 GB chip, at the
                 # documented cost of bf16 addition noise across the
                 # accumulation window (reference data_types knob)
-                acc_dt = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
-                          "fp16": jnp.float16, "float16": jnp.float16,
-                          "fp32": jnp.float32, "float32": jnp.float32}.get(
-                    self._config.gradient_accumulation_dtype or "fp32",
-                    jnp.float32)
-                grads = jax.tree.map(lambda g: g.astype(acc_dt), grads)
+                table = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                         "fp16": jnp.float16, "float16": jnp.float16,
+                         "fp32": jnp.float32, "float32": jnp.float32}
+                want = self._config.gradient_accumulation_dtype or "fp32"
+                if want not in table:
+                    raise ValueError(
+                        f"data_types.grad_accum_dtype={want!r}: expected "
+                        f"one of {sorted(table)} (or null = fp32)")
+                grads = jax.tree.map(lambda g: g.astype(table[want]), grads)
                 flat = jax.tree.leaves(grads)
                 found_inf = jnp.logical_not(
                     jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in flat])))

@@ -352,3 +352,101 @@ def test_chunk_prefill_stacked_int8():
                 q[b:b + 1], jnp.asarray(kdq[b:b + 1]),
                 jnp.asarray(vdq[b:b + 1]), pos))[0]
             np.testing.assert_allclose(got[b], want, rtol=2e-4, atol=2e-4)
+
+
+# --------------------------------------------------------------------- #
+# fused in-kernel cache write (new_k/new_v)
+# --------------------------------------------------------------------- #
+
+def _write_rows_ref(cache, rows, lengths):
+    """Reference: write rows [B, KVH*D] at per-row positions lengths-1."""
+    out = np.asarray(cache).copy()
+    for b in range(out.shape[0]):
+        out[b, int(lengths[b]) - 1] = rows[b]
+    return jnp.asarray(out)
+
+
+@pytest.mark.parametrize("kvh", [4, 2])
+def test_fused_write_matches_prewrite(kvh):
+    """decode_attention(new_k=, new_v=) must equal pre-writing the row
+    then attending — same outputs AND same cache contents afterward."""
+    B, H, D, S_max = 3, 4, 16, 128
+    rng = np.random.default_rng(kvh)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, kvh, S_max, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, kvh, S_max, D)), jnp.float32)
+    ks, vs = to_smajor(k), to_smajor(v)
+    lengths = jnp.asarray([5, 64, 128], jnp.int32)   # incl. a block edge
+    kn = rng.standard_normal((B, kvh, D)).astype(np.float32)
+    vn = rng.standard_normal((B, kvh, D)).astype(np.float32)
+    # reference: write first, then plain kernel
+    ks_w = _write_rows_ref(ks, kn.reshape(B, kvh * D), lengths)
+    vs_w = _write_rows_ref(vs, vn.reshape(B, kvh * D), lengths)
+    want = np.asarray(decode_attention(q, ks_w, vs_w, lengths, block_k=32))
+    got, ko, vo = decode_attention(q, ks, vs, lengths, block_k=32,
+                                   new_k=jnp.asarray(kn),
+                                   new_v=jnp.asarray(vn))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(ko), np.asarray(ks_w),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(vo), np.asarray(vs_w),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_fused_write_int8_stacked():
+    """Quantized + layer-stacked fused write: payload/scale rows written
+    by the kernel must match the model's quantization, and the attention
+    must match the unfused write-then-read path."""
+    rng = np.random.default_rng(0)
+    L, B, KVH, S_max, D, H = 2, 2, 4, 96, 16, 8
+    k = rng.standard_normal((L, B, KVH, S_max, D)) * 3.0
+    v = rng.standard_normal((L, B, KVH, S_max, D))
+    ksm = to_smajor(jnp.asarray(k, jnp.float32))
+    vsm = to_smajor(jnp.asarray(v, jnp.float32))
+    kq, ksc = quantize_smajor(ksm, KVH)
+    vq, vsc = quantize_smajor(vsm, KVH)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    lengths = jnp.asarray([33, 80], jnp.int32)
+    kn = jnp.asarray(rng.standard_normal((B, KVH, D)) * 3.0, jnp.float32)
+    vn = jnp.asarray(rng.standard_normal((B, KVH, D)), jnp.float32)
+    for li in range(L):
+        got, ko, vo, kso, vso = decode_attention(
+            q, kq, vq, lengths, block_k=32, layer=jnp.asarray(li),
+            k_scale=ksc, v_scale=vsc, new_k=kn, new_v=vn)
+        # reference: quantize the rows the model's way, write, then attend
+        def quant_rows(new):
+            r = np.asarray(new, np.float32)
+            s = np.max(np.abs(r), axis=-1) / 127.0
+            safe = np.where(s == 0.0, 1.0, s)
+            pay = np.clip(np.round(r / safe[..., None]), -127, 127)
+            return pay, s
+        kpay, ksn = quant_rows(kn)
+        vpay, vsn = quant_rows(vn)
+        kq_w = np.asarray(kq).copy()
+        vq_w = np.asarray(vq).copy()
+        ksc_w = np.asarray(ksc).copy()
+        vsc_w = np.asarray(vsc).copy()
+        for b in range(B):
+            p = int(lengths[b]) - 1
+            kq_w[li, b, p] = kpay[b].reshape(-1)
+            vq_w[li, b, p] = vpay[b].reshape(-1)
+            ksc_w[li, b, p] = ksn[b]
+            vsc_w[li, b, p] = vsn[b]
+        want = np.asarray(decode_attention(
+            q, jnp.asarray(kq_w), jnp.asarray(vq_w), lengths, block_k=32,
+            layer=jnp.asarray(li), k_scale=jnp.asarray(ksc_w),
+            v_scale=jnp.asarray(vsc_w)))
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_array_equal(np.asarray(ko)[li, [0, 1],
+                                                     lengths - 1],
+                                      kq_w[li, [0, 1], lengths - 1])
+        np.testing.assert_array_equal(np.asarray(vo)[li, [0, 1],
+                                                     lengths - 1],
+                                      vq_w[li, [0, 1], lengths - 1])
+        np.testing.assert_allclose(
+            np.asarray(kso)[li, [0, 1], lengths - 1],
+            ksc_w[li, [0, 1], lengths - 1], rtol=1e-6, atol=1e-6)
+        # untouched rows preserved through the aliased outputs
+        np.testing.assert_array_equal(np.asarray(ko)[li, 0, :32],
+                                      np.asarray(kq)[li, 0, :32])

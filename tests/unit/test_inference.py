@@ -449,7 +449,7 @@ def test_chunked_prefill_pad_overflow(model_and_params):
     model, params, ids = model_and_params          # prompt len 12
     rng = jax.random.key(5)
     new = 2                                        # 12+2=14 < padded 15
-    assert required_cache_len(12, new, 5) == 15
+    assert required_cache_len(12, new, 5) == 16    # padded 15, 8-rounded
     ref_fn = make_generate_fn(model, jnp.float32, 12, new,
                               False, 1.0, 0, 1.0, prefill_chunk=None)
     cache = model.init_cache(2, required_cache_len(12, new, None),
@@ -467,3 +467,27 @@ def test_chunked_prefill_pad_overflow(model_and_params):
     engine.set_params(params)
     out = np.asarray(engine.generate(ids, max_new_tokens=new))
     np.testing.assert_array_equal(out, want)
+
+
+def test_split_prefill_generation_matches_one_pass(model_and_params):
+    """The engine split-prefill path (n_chunks > 2: per-chunk donated
+    executable + decode-only program) must match one-pass generation,
+    masked and unmasked."""
+    model, params, ids = model_and_params          # prompt len 12
+    ref = deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+    ref.set_params(params)
+    want = np.asarray(ref.generate(ids, max_new_tokens=6))
+
+    eng = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32", "prefill_chunk_size": 3})
+    eng.set_params(params)
+    got = np.asarray(eng.generate(ids, max_new_tokens=6))   # 4 chunks
+    np.testing.assert_array_equal(got, want)
+
+    mask = np.ones(ids.shape, np.int32)
+    mask[1, -5:] = 0
+    want_m = np.asarray(ref.generate(ids, max_new_tokens=4,
+                                     attention_mask=mask))
+    got_m = np.asarray(eng.generate(ids, max_new_tokens=4,
+                                    attention_mask=mask))
+    np.testing.assert_array_equal(got_m, want_m)

@@ -256,7 +256,11 @@ class InferenceEngine:
             return None
         if cfg == "auto":
             return default_prefill_chunk(batch_size, prompt_len)
-        return int(cfg) if int(cfg) < prompt_len else None
+        # the chunk kernel's VMEM accumulator bounds C at 512; a larger
+        # configured chunk would silently fall to the dense attend path
+        # (the [B,H,S,S_max] fp32 transient chunking exists to avoid)
+        c = min(int(cfg), 512)
+        return c if c < prompt_len else None
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=-1, seed=None,

@@ -522,6 +522,11 @@ def decode_attention(q, k_cache, v_cache, lengths,
             f"fused cache write needs S_max % 8 == 0 (8-sublane-aligned "
             f"write stripes); got {k_cache.shape[-2]} — round the cache "
             f"length up (required_cache_len does)")
+    if fused_write and min(block_k, k_cache.shape[-2]) % 8 != 0:
+        raise ValueError(
+            f"fused cache write needs block_k % 8 == 0 (the in-block "
+            f"stripe base assumes 8-aligned blocks); got block_k="
+            f"{min(block_k, k_cache.shape[-2])}")
     mxu_int8 = bool(int8_matmuls)
     S_max, KVHD = k_cache.shape[-2], k_cache.shape[-1]
     KVH = KVHD // D

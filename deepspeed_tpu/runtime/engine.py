@@ -58,6 +58,10 @@ from deepspeed_tpu.utils.timer import (SynchronizedWallClockTimer, ThroughputTim
 
 MEMORY_OPT_ALLREDUCE_SIZE = 500_000_000
 
+# _pending marker: this micro's gradients were already added into the
+# running accumulator by the fused forward program (see forward())
+_ACCUMULATED = object()
+
 
 def _unscale_and_clip(grads, scale, clip):
     """Unscale by the loss scale, compute the global grad norm, clip
@@ -455,43 +459,68 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------ #
     # forward / backward / step
     # ------------------------------------------------------------------ #
+    def _fwd_bwd_core(self, params, scale, rng, *args, **kwargs):
+        """Traced body shared by ``_get_fwd_bwd`` (fresh grads) and
+        ``_get_fwd_bwd_acc`` (fused accumulate)."""
+        gas = self.gradient_accumulation_steps()
+
+        def loss_of(p):
+            out = self._apply_model(p, args, kwargs, rng, train=True)
+            loss, aux = self._extract_loss(out)
+            # reference engine.py:1821: scale loss by 1/GAS
+            scaled = loss.astype(jnp.float32) * scale / gas
+            return scaled, (loss, aux)
+
+        grads, (loss, aux) = jax.grad(loss_of, has_aux=True)(params)
+        # grad accumulation dtype: fp32 by default even when working
+        # params are 16-bit (offload path; reference stage_1_and_2.py
+        # fp32 accum); ``data_types.grad_accum_dtype: "bf16"`` halves the
+        # accumulator — the enabler for 2.7B-class offload on a 16 GB
+        # chip, at the documented cost of bf16 addition noise across the
+        # accumulation window (reference data_types knob)
+        table = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                 "fp16": jnp.float16, "float16": jnp.float16,
+                 "fp32": jnp.float32, "float32": jnp.float32}
+        want = self._config.gradient_accumulation_dtype or "fp32"
+        if want not in table:
+            raise ValueError(
+                f"data_types.grad_accum_dtype={want!r}: expected "
+                f"one of {sorted(table)} (or null = fp32)")
+        grads = jax.tree.map(lambda g: g.astype(table[want]), grads)
+        flat = jax.tree.leaves(grads)
+        found_inf = jnp.logical_not(
+            jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in flat])))
+        return grads, loss, found_inf
+
     def _get_fwd_bwd(self):
         key = "fwd_bwd"
         if key not in self._compiled:
-            gas = self.gradient_accumulation_steps()
+            self._compiled[key] = jax.jit(
+                self._fwd_bwd_core,
+                out_shardings=(self._plan.grad_shardings,
+                               NamedSharding(self.mesh, P()),
+                               NamedSharding(self.mesh, P())))
+        return self._compiled[key]
 
-            def fwd_bwd(params, scale, rng, *args, **kwargs):
-                def loss_of(p):
-                    out = self._apply_model(p, args, kwargs, rng, train=True)
-                    loss, aux = self._extract_loss(out)
-                    # reference engine.py:1821: scale loss by 1/GAS
-                    scaled = loss.astype(jnp.float32) * scale / gas
-                    return scaled, (loss, aux)
+    def _get_fwd_bwd_acc(self):
+        """Fused gradient-compute + accumulate: like ``_get_fwd_bwd`` but
+        the running accumulator rides in as a DONATED argument and the
+        program returns ``acc + grads`` — the fresh gradient tree never
+        coexists with params AND the accumulator as a third full-size
+        tree (see forward())."""
+        key = "fwd_bwd_acc"
+        if key not in self._compiled:
+            fwd_bwd_core = self._fwd_bwd_core
 
-                grads, (loss, aux) = jax.grad(loss_of, has_aux=True)(params)
-                # grad accumulation dtype: fp32 by default even when working
-                # params are 16-bit (offload path; reference
-                # stage_1_and_2.py fp32 accum); ``data_types.
-                # grad_accum_dtype: "bf16"`` halves the accumulator — the
-                # enabler for 2.7B-class offload on a 16 GB chip, at the
-                # documented cost of bf16 addition noise across the
-                # accumulation window (reference data_types knob)
-                table = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
-                         "fp16": jnp.float16, "float16": jnp.float16,
-                         "fp32": jnp.float32, "float32": jnp.float32}
-                want = self._config.gradient_accumulation_dtype or "fp32"
-                if want not in table:
-                    raise ValueError(
-                        f"data_types.grad_accum_dtype={want!r}: expected "
-                        f"one of {sorted(table)} (or null = fp32)")
-                grads = jax.tree.map(lambda g: g.astype(table[want]), grads)
-                flat = jax.tree.leaves(grads)
-                found_inf = jnp.logical_not(
-                    jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in flat])))
-                return grads, loss, found_inf
+            def fwd_bwd_acc(params, acc, scale, rng, *args, **kwargs):
+                grads, loss, found_inf = fwd_bwd_core(params, scale, rng,
+                                                      *args, **kwargs)
+                acc = jax.tree.map(jnp.add, acc, grads)
+                return acc, loss, found_inf
 
             self._compiled[key] = jax.jit(
-                fwd_bwd,
+                fwd_bwd_acc,
+                donate_argnums=(1,),
                 out_shardings=(self._plan.grad_shardings,
                                NamedSharding(self.mesh, P()),
                                NamedSharding(self.mesh, P())))
@@ -589,9 +618,34 @@ class DeepSpeedEngine:
                 self.timers(FORWARD_GLOBAL_TIMER).stop()
             return out
         self.tput_timer.start()
-        grads, loss, found_inf = self._get_fwd_bwd()(
-            self._params, self._scaler_state.scale, step_rng, *args, **kwargs)
-        self._pending = (grads, found_inf)
+        if self._grad_acc is None:
+            grads, loss, found_inf = self._get_fwd_bwd()(
+                self._params, self._scaler_state.scale, step_rng,
+                *args, **kwargs)
+            self._pending = (grads, found_inf)
+        else:
+            if getattr(self, "_pending", None) is not None:
+                raise RuntimeError(
+                    "forward() called twice without backward(): gradients "
+                    "accumulate INTO the running buffer in one fused "
+                    "program (the reference's is_gradient_accumulation "
+                    "contract) — call backward(loss) after each forward")
+            # micro-steps after the first ADD into the donated running
+            # accumulator inside the SAME program that computes the
+            # gradients: a separate grad tree + accumulate would hold
+            # THREE param-sized trees at the boundary (params + acc +
+            # fresh grads = 15.9 GB at 2.7B bf16 — the OOM that killed
+            # the first single-chip 2.7B run); fused, XLA folds each
+            # layer's add into its grad computation and the fresh tree
+            # never fully materializes
+            # detach the accumulator BEFORE the donating call: a failure
+            # mid-program would otherwise leave self._grad_acc bound to
+            # the donated (deleted) buffer and poison the next micro-step
+            acc, self._grad_acc = self._grad_acc, None
+            self._grad_acc, loss, found_inf = self._get_fwd_bwd_acc()(
+                self._params, acc, self._scaler_state.scale,
+                step_rng, *args, **kwargs)
+            self._pending = (_ACCUMULATED, found_inf)
         self._last_loss = loss
         if self.wall_clock_breakdown():
             self.timers(FORWARD_GLOBAL_TIMER).stop()
@@ -611,7 +665,12 @@ class DeepSpeedEngine:
             self.timers(BACKWARD_GLOBAL_TIMER).start()
         grads, found_inf = self._pending
         self._pending = None
-        if self._grad_acc is None:
+        if grads is _ACCUMULATED:
+            # forward already added this micro's grads into the running
+            # accumulator (fused program — see forward)
+            self._found_inf_acc = jnp.logical_or(self._found_inf_acc,
+                                                 found_inf)
+        elif self._grad_acc is None:
             self._grad_acc = grads
             self._found_inf_acc = found_inf
         else:
@@ -716,13 +775,18 @@ class DeepSpeedEngine:
         """Host optimizer step (ZeRO-Offload): device prep -> host C++ Adam
         -> bf16 upload (reference stage_1_and_2.py:1630 CPU Adam step +
         :1750 updated-param gather)."""
-        grads, gnorm = self._get_offload_prep()(self._grad_acc,
+        # detach before the donating call (failure safety — see forward)
+        acc, self._grad_acc = self._grad_acc, None
+        grads, gnorm = self._get_offload_prep()(acc,
                                                 self._scaler_state.scale)
         self._last_global_grad_norm = gnorm
         found_inf = bool(jax.device_get(self._found_inf_acc)) \
             if self._found_inf_acc is not None else False
         if not found_inf:
             host_grads = [np.asarray(g) for g in jax.device_get(jax.tree.leaves(grads))]
+            del grads                      # free the device grads BEFORE
+            # the param upload — holding them alongside old + new params
+            # is three param-sized trees (the 2.7B boundary OOM)
             # fp32 compute must upload the fp32 masters directly — rounding
             # working params through bf16 every step would silently degrade
             # full-precision training
@@ -730,11 +794,16 @@ class DeepSpeedEngine:
             leaves = self._host_opt.step(host_grads, lr=self.get_lr()[0],
                                          fp32_out=want_fp32)
             new_tree = self._host_opt.leaves_to_tree(leaves)
-            if "offload_put" not in self._compiled:
-                self._compiled["offload_put"] = jax.jit(
-                    lambda t: t, out_shardings=self._plan.param_shardings)
-            self._params = self._compiled["offload_put"](jax.tree.map(
-                lambda a, old: jnp.asarray(a, dtype=old.dtype), new_tree, self._params))
+            dtypes = jax.tree.map(lambda p: p.dtype, self._params)
+            self._params = None            # free old params before upload
+            new_tree = jax.tree.map(
+                lambda a, dt: a if a.dtype == dt else a.astype(dt),
+                new_tree, dtypes)
+            # one host->device transfer straight into the sharded layout —
+            # an eager asarray + re-placement jit would hold two device
+            # copies of the new params
+            self._params = jax.device_put(new_tree,
+                                          self._plan.param_shardings)
         else:
             self.skipped_steps += 1
         self._scaler_state = self.loss_scaler.update(

@@ -450,3 +450,43 @@ def test_fused_write_int8_stacked():
         # untouched rows preserved through the aliased outputs
         np.testing.assert_array_equal(np.asarray(ko)[li, 0, :32],
                                       np.asarray(kq)[li, 0, :32])
+
+
+def test_fused_write_sliding_window():
+    """Fused write + sliding-window decode: the fresh row's score
+    substitution and the window's live mask interact at the write block —
+    must match pre-writing the row then windowed attention."""
+    B, H, D, S_max, W = 2, 4, 16, 128, 48
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, S_max, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, S_max, D)), jnp.float32)
+    ks, vs = to_smajor(k), to_smajor(v)
+    # lengths straddling block edges AND the window boundary
+    lengths = jnp.asarray([40, 104], jnp.int32)
+    kn = rng.standard_normal((B, H, D)).astype(np.float32)
+    vn = rng.standard_normal((B, H, D)).astype(np.float32)
+    ks_w = _write_rows_ref(ks, kn.reshape(B, H * D), lengths)
+    vs_w = _write_rows_ref(vs, vn.reshape(B, H * D), lengths)
+    want = np.asarray(decode_attention(q, ks_w, vs_w, lengths, block_k=32,
+                                       window=W))
+    got, ko, vo = decode_attention(q, ks, vs, lengths, block_k=32,
+                                   window=W, new_k=jnp.asarray(kn),
+                                   new_v=jnp.asarray(vn))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(ko), np.asarray(ks_w),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_fused_write_rejects_bad_blocks():
+    B, H, D, S_max = 1, 4, 16, 96
+    q = jnp.zeros((B, H, D), jnp.float32)
+    c = jnp.zeros((B, S_max, H * D), jnp.float32)
+    n = jnp.zeros((B, H, D), jnp.float32)
+    with pytest.raises(ValueError, match="block_k % 8"):
+        decode_attention(q, c, c, jnp.asarray([5], jnp.int32), block_k=20,
+                         new_k=n, new_v=n)
+    odd = jnp.zeros((B, 92, H * D), jnp.float32)
+    with pytest.raises(ValueError, match="S_max % 8"):
+        decode_attention(q, odd, odd, jnp.asarray([5], jnp.int32),
+                         new_k=n, new_v=n)

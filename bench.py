@@ -197,7 +197,7 @@ def calibrate_bench():
 def train_bench(model_name, *, micro_bs, zero_stage, steps, seq=2048,
                 lean=False, remat=False, remat_policy="dots_and_attn_saveable",
                 scan_layers=False, fused_qkv=False, loss_chunks=8,
-                gas=1, offload=None, grad_accum_dtype=None):
+                gas=1, offload=None, grad_accum_dtype=None, grad_groups=1):
     """``offload``: None (in-HBM optimizer) | "cpu" (ZeRO-Offload: bf16
     working params on device, fp32 masters+moments in host RAM, the C++
     SIMD Adam steps them) | "nvme" (moments/masters in swap files through
@@ -233,6 +233,8 @@ def train_bench(model_name, *, micro_bs, zero_stage, steps, seq=2048,
             "device": offload, "pipeline_read": offload == "nvme",
             **({"nvme_path": "/tmp/dstpu_bench_nvme"}
                if offload == "nvme" else {})}
+    if grad_groups > 1:
+        config["zero_optimization"]["grad_partition_groups"] = grad_groups
     if grad_accum_dtype:
         config["data_types"] = {"grad_accum_dtype": grad_accum_dtype}
     engine, *_ = deepspeed_tpu.initialize(model=model, config=config)
@@ -654,14 +656,18 @@ def _sft27(fallback):
     amortizing the per-boundary host round trip — the reference's
     single-GPU large-model recipe (blogs/deepspeed-chat README:64-66,
     OPT-13B on one A100-80G via offload)."""
-    # flash_only remat both ways: at 2.7B the dots-saveable set is ~7 GB
-    # of activations on top of params+accumulator — it does not fit
+    # flash_only remat + 4-way partitioned backward: bf16 params + bf16
+    # accumulator are 10.6 GB, and a one-pass backward's gradient
+    # temporaries (~4 GB measured by memory_analysis) push the boundary
+    # over this chip's budget — grad_partition_groups trades (N-1) extra
+    # backward sweeps (free: the step is host-link-bound) for 1/N grad
+    # temps
     r = train_bench("opt-2.7b", micro_bs=1, zero_stage=2,
-                    steps=2 if fallback else 3,
-                    gas=8 if fallback else 32,
+                    steps=2,
+                    gas=4 if fallback else 8,
                     remat=True, remat_policy="flash_only_saveable",
                     offload="cpu", grad_accum_dtype="bf16",
-                    loss_chunks=8)
+                    grad_groups=4, loss_chunks=8)
     r["bottleneck"] = (
         "host link: the tunneled device moves ~0.07 GB/s (calibration "
         "host_to_device_gbps) vs 16-32 GB/s PCIe, so the per-boundary "

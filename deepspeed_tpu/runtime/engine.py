@@ -63,6 +63,17 @@ MEMORY_OPT_ALLREDUCE_SIZE = 500_000_000
 _ACCUMULATED = object()
 
 
+def _finish_grads(grads, acc_dt):
+    """Shared epilogue of every backward variant: cast to the accumulation
+    dtype and derive the overflow flag (one place — the grouped and
+    one-pass paths must never diverge here)."""
+    grads = jax.tree.map(lambda g: g.astype(acc_dt), grads)
+    leaves = jax.tree.leaves(grads)
+    found_inf = jnp.logical_not(jnp.all(jnp.stack(
+        [jnp.all(jnp.isfinite(g)) for g in leaves])))
+    return grads, found_inf
+
+
 def _unscale_and_clip(grads, scale, clip):
     """Unscale by the loss scale, compute the global grad norm, clip
     (reference ``stage_1_and_2.py:1791`` unscale_and_clip_grads)."""
@@ -478,18 +489,7 @@ class DeepSpeedEngine:
         # accumulator — the enabler for 2.7B-class offload on a 16 GB
         # chip, at the documented cost of bf16 addition noise across the
         # accumulation window (reference data_types knob)
-        table = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
-                 "fp16": jnp.float16, "float16": jnp.float16,
-                 "fp32": jnp.float32, "float32": jnp.float32}
-        want = self._config.gradient_accumulation_dtype or "fp32"
-        if want not in table:
-            raise ValueError(
-                f"data_types.grad_accum_dtype={want!r}: expected "
-                f"one of {sorted(table)} (or null = fp32)")
-        grads = jax.tree.map(lambda g: g.astype(table[want]), grads)
-        flat = jax.tree.leaves(grads)
-        found_inf = jnp.logical_not(
-            jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in flat])))
+        grads, found_inf = _finish_grads(grads, self._accum_dtype())
         return grads, loss, found_inf
 
     def _get_fwd_bwd(self):
@@ -501,6 +501,108 @@ class DeepSpeedEngine:
                                NamedSharding(self.mesh, P()),
                                NamedSharding(self.mesh, P())))
         return self._compiled[key]
+
+    def _accum_dtype(self):
+        table = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                 "fp16": jnp.float16, "float16": jnp.float16,
+                 "fp32": jnp.float32, "float32": jnp.float32}
+        want = self._config.gradient_accumulation_dtype or "fp32"
+        if want not in table:
+            raise ValueError(
+                f"data_types.grad_accum_dtype={want!r}: expected "
+                f"one of {sorted(table)} (or null = fp32)")
+        return table[want]
+
+    def _group_bounds(self, n_groups):
+        """Contiguous leaf-index ranges of ~equal parameter bytes for the
+        partitioned backward (zero_optimization.grad_partition_groups)."""
+        sizes = [int(np.prod(l.shape)) * l.dtype.itemsize
+                 for l in jax.tree.leaves(self._params)]
+        total = sum(sizes)
+        bounds, lo, acc = [], 0, 0
+        for i, s in enumerate(sizes):
+            acc += s
+            if acc >= total * (len(bounds) + 1) / n_groups \
+                    and len(bounds) < n_groups - 1:
+                bounds.append((lo, i + 1))
+                lo = i + 1
+        bounds.append((lo, len(sizes)))
+        return [b for b in bounds if b[0] < b[1]]
+
+    def _get_fwd_bwd_group(self, lo, hi):
+        """Partitioned backward: gradients for leaves [lo:hi) only — the
+        other parameters enter the loss as constants, so this program's
+        gradient temporaries are ~1/N of the tree.  Each group re-runs
+        the forward+backward sweep (FLOPs for memory — the trade that
+        fits 2.7B's boundary on one 16 GB chip, where the step is
+        host-link-bound anyway)."""
+        key = ("fwd_bwd_group", lo, hi)
+        if key not in self._compiled:
+            gas = self.gradient_accumulation_steps()
+            acc_dt = self._accum_dtype()
+
+            def fwd_bwd_g(params, acc_slice, scale, rng, *args, **kwargs):
+                flat, treedef = jax.tree_util.tree_flatten(params)
+
+                def loss_of(group):
+                    flat2 = list(flat)
+                    flat2[lo:hi] = group
+                    p = jax.tree_util.tree_unflatten(treedef, flat2)
+                    out = self._apply_model(p, args, kwargs, rng,
+                                            train=True)
+                    loss, aux = self._extract_loss(out)
+                    return loss.astype(jnp.float32) * scale / gas, loss
+
+                grads, loss = jax.grad(loss_of, has_aux=True)(flat[lo:hi])
+                grads, found_inf = _finish_grads(grads, acc_dt)
+                acc_slice = [a + g for a, g in zip(acc_slice, grads)]
+                return acc_slice, loss, found_inf
+
+            gshard = jax.tree.leaves(self._plan.grad_shardings)[lo:hi]
+            self._compiled[key] = jax.jit(
+                fwd_bwd_g,
+                donate_argnums=(1,),
+                out_shardings=(gshard,
+                               NamedSharding(self.mesh, P()),
+                               NamedSharding(self.mesh, P())))
+        return self._compiled[key]
+
+    def _forward_grouped(self, n_groups, step_rng, args, kwargs):
+        """One micro-step through the partitioned backward (see
+        ``_get_fwd_bwd_group``): every group pass adds its gradient slice
+        into the running accumulator in place."""
+        if self._grad_acc is None:
+            if "acc_zeros" not in self._compiled:
+                acc_dt = self._accum_dtype()
+                # close over SHAPES only — capturing the live param arrays
+                # would pin this window's params forever (they are
+                # replaced every optimizer step)
+                shapes = jax.tree.map(lambda l: l.shape, self._params)
+                self._compiled["acc_zeros"] = jax.jit(
+                    lambda: jax.tree.map(
+                        lambda s: jnp.zeros(s, acc_dt), shapes,
+                        is_leaf=lambda x: isinstance(x, tuple)),
+                    out_shardings=self._plan.grad_shardings)
+            self._grad_acc = self._compiled["acc_zeros"]()
+            self._found_inf_acc = jnp.asarray(False)
+        flat_acc, acc_def = jax.tree_util.tree_flatten(self._grad_acc)
+        self._grad_acc = None              # detach before donating calls
+        loss = found = None
+        try:
+            for lo, hi in self._group_bounds(n_groups):
+                new_slice, loss, fi = self._get_fwd_bwd_group(lo, hi)(
+                    self._params, flat_acc[lo:hi], self._scaler_state.scale,
+                    step_rng, *args, **kwargs)
+                flat_acc[lo:hi] = list(new_slice)
+                found = fi if found is None else jnp.logical_or(found, fi)
+        except BaseException:
+            # a failed pass leaves donated (dead) slices behind — keep the
+            # accumulator detached (None) so the next micro-step restarts
+            # the window instead of feeding deleted buffers back in
+            self._grad_acc = None
+            raise
+        self._grad_acc = jax.tree_util.tree_unflatten(acc_def, flat_acc)
+        return loss, found
 
     def _get_fwd_bwd_acc(self):
         """Fused gradient-compute + accumulate: like ``_get_fwd_bwd`` but
@@ -618,6 +720,20 @@ class DeepSpeedEngine:
                 self.timers(FORWARD_GLOBAL_TIMER).stop()
             return out
         self.tput_timer.start()
+        n_groups = int(getattr(self._config.zero_config,
+                               "grad_partition_groups", 1) or 1)
+        if n_groups > 1:
+            if getattr(self, "_pending", None) is not None:
+                raise RuntimeError(
+                    "forward() called twice without backward() (grouped "
+                    "accumulation adds into the running buffer)")
+            loss, found_inf = self._forward_grouped(n_groups, step_rng,
+                                                    args, kwargs)
+            self._pending = (_ACCUMULATED, found_inf)
+            self._last_loss = loss
+            if self.wall_clock_breakdown():
+                self.timers(FORWARD_GLOBAL_TIMER).stop()
+            return loss
         if self._grad_acc is None:
             grads, loss, found_inf = self._get_fwd_bwd()(
                 self._params, self._scaler_state.scale, step_rng,
@@ -899,9 +1015,13 @@ class DeepSpeedEngine:
         else:
             # batch already stacked [gas, micro_batch, ...]
             pass
-        if self._offload_cfg is not None:
+        n_groups = int(getattr(self._config.zero_config,
+                               "grad_partition_groups", 1) or 1)
+        if self._offload_cfg is not None or n_groups > 1:
             # offload path: the optimizer lives on host, so the step cannot
-            # fuse into one XLA program — run the 3-call sequence per micro
+            # fuse into one XLA program — run the 3-call sequence per micro.
+            # Same for the partitioned backward (grad_partition_groups):
+            # the memory lever lives in forward()'s grouped passes
             micro_losses = []
             for i in range(gas):
                 mb = jax.tree.map(lambda x: x[i], batch)

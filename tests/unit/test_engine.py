@@ -251,3 +251,41 @@ def test_fresh_engine_load_module_only(tmp_path):
     # fresh optimizer state: training continues from the loaded weights
     losses = train_steps(e2, steps=3, seed=7)
     assert np.isfinite(losses).all(), losses
+
+
+def test_grad_partition_groups_matches_full_backward():
+    """zero_optimization.grad_partition_groups: N partial backward passes
+    (each materializing ~1/N of the gradient tree) must accumulate the
+    SAME gradients as the one-pass path — identical loss trajectory over
+    several accumulation boundaries."""
+    import numpy as np
+    import deepspeed_tpu
+    from tests.unit.simple_model import SimpleModel
+
+    def run(groups):
+        engine, *_ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=16),
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "gradient_accumulation_steps": 2,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+                    "zero_optimization": {"stage": 0,
+                                          "grad_partition_groups": groups},
+                    "gradient_clipping": 1.0})
+        rng = np.random.default_rng(0)
+        losses = []
+        for step in range(3):
+            for micro in range(2):
+                batch = {
+                    "x": rng.standard_normal((2 * engine.topology.dp, 16))
+                    .astype(np.float32),
+                    "y": rng.integers(0, 16, (2 * engine.topology.dp,))
+                    .astype(np.int32)}
+                loss = engine(batch)
+                engine.backward(loss)
+                losses.append(float(jax.device_get(loss)))
+            engine.step()
+        return losses
+
+    ref = run(1)
+    got = run(3)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)

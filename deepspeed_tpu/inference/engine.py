@@ -284,14 +284,16 @@ class InferenceEngine:
         B, P = input_ids.shape
         chunk = self._prefill_chunk_for(B, P)
         n_chunks = -(-P // chunk) if chunk else 1
-        if n_chunks > 2:
-            # many chunks: run prefill as REPEATED CALLS of one per-chunk
+        if n_chunks > 1:
+            # chunked prefill runs as REPEATED CALLS of one per-chunk
             # executable instead of an in-program scan — the scan's
             # while-loop carries a partial extra copy of the cache that
-            # XLA will not alias away (measured ~2.8 GB at a 4k cache),
-            # and per-call the donated cache aliases straight through, so
-            # peak memory is max(chunk program, decode program), not
-            # their union.  Costs one dispatch per chunk.
+            # XLA will not alias away (measured ~2.8 GB at a 4k cache;
+            # the same copy at bs128's 5.1 GB cache OOM'd the 2-chunk
+            # in-program form), and per-call the donated cache aliases
+            # straight through, so peak memory is max(chunk program,
+            # decode program), not their union.  Costs one dispatch per
+            # chunk (~0.1 s each on the tunnel).
             return self._generate_split(
                 input_ids, int(max_new_tokens), bool(do_sample),
                 float(temperature), int(top_k), float(top_p),

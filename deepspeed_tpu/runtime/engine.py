@@ -720,10 +720,24 @@ class DeepSpeedEngine:
                 self.timers(FORWARD_GLOBAL_TIMER).stop()
             return out
         self.tput_timer.start()
+        if getattr(self, "_pending", None) is not None \
+                and self._grad_acc is not None:
+            # gradients from the un-backward()ed forward are already IN
+            # the running accumulator (fused/grouped paths) or would be
+            # silently dropped mid-window — either way the window would
+            # train on the wrong gradient sum.  (A fresh forward with NO
+            # window in flight stays allowed: loss-only forwards are a
+            # legitimate pattern and their pending grads are discarded.)
+            raise RuntimeError(
+                "forward() called twice without backward() inside an "
+                "accumulation window — call backward(loss) after each "
+                "forward")
         n_groups = int(getattr(self._config.zero_config,
                                "grad_partition_groups", 1) or 1)
         if n_groups > 1:
             if getattr(self, "_pending", None) is not None:
+                # grouped mode accumulates on the FIRST micro too — a
+                # pending forward's grads are already in the buffer
                 raise RuntimeError(
                     "forward() called twice without backward() (grouped "
                     "accumulation adds into the running buffer)")
@@ -740,12 +754,6 @@ class DeepSpeedEngine:
                 *args, **kwargs)
             self._pending = (grads, found_inf)
         else:
-            if getattr(self, "_pending", None) is not None:
-                raise RuntimeError(
-                    "forward() called twice without backward(): gradients "
-                    "accumulate INTO the running buffer in one fused "
-                    "program (the reference's is_gradient_accumulation "
-                    "contract) — call backward(loss) after each forward")
             # micro-steps after the first ADD into the donated running
             # accumulator inside the SAME program that computes the
             # gradients: a separate grad tree + accumulate would hold
@@ -876,33 +884,36 @@ class DeepSpeedEngine:
                 self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
                                  STEP_GLOBAL_TIMER])
 
-    def _get_offload_prep(self):
-        """Jitted device-side epilogue for the offload step: unscale + clip
-        + global norm on the (ZeRO-sharded) grad accumulator."""
-        key = "offload_prep"
-        if key not in self._compiled:
-            clip = float(self.gradient_clipping() or 0.0)
-            self._compiled[key] = jax.jit(
-                lambda grads, scale: _unscale_and_clip(grads, scale, clip),
-                donate_argnums=(0,))
-        return self._compiled[key]
-
     def _offload_step(self, lr_kwargs=None):
-        """Host optimizer step (ZeRO-Offload): device prep -> host C++ Adam
-        -> bf16 upload (reference stage_1_and_2.py:1630 CPU Adam step +
-        :1750 updated-param gather)."""
-        # detach before the donating call (failure safety — see forward)
-        acc, self._grad_acc = self._grad_acc, None
-        grads, gnorm = self._get_offload_prep()(acc,
-                                                self._scaler_state.scale)
-        self._last_global_grad_norm = gnorm
+        """Host optimizer step (ZeRO-Offload): host-side unscale/clip ->
+        host C++ Adam -> upload (reference stage_1_and_2.py:1630 CPU Adam
+        step + :1750 updated-param gather).  The unscale + global-norm
+        clip run ON HOST (numpy, fp32): a device prep program at the
+        boundary held grad-sized temps next to params + accumulator —
+        the last straw for 2.7B on a 16 GB chip — and the grads are
+        crossing to the host anyway."""
+        flat_acc = list(jax.tree.leaves(self._grad_acc))
+        self._grad_acc = None
         found_inf = bool(jax.device_get(self._found_inf_acc)) \
             if self._found_inf_acc is not None else False
         if not found_inf:
-            host_grads = [np.asarray(g) for g in jax.device_get(jax.tree.leaves(grads))]
-            del grads                      # free the device grads BEFORE
-            # the param upload — holding them alongside old + new params
-            # is three param-sized trees (the 2.7B boundary OOM)
+            host_grads = []
+            for i in range(len(flat_acc)):
+                host_grads.append(np.asarray(jax.device_get(flat_acc[i]),
+                                             dtype=np.float32))
+                flat_acc[i] = None         # free each device leaf as it
+                # lands — never hold the full acc on BOTH sides
+            inv = 1.0 / float(jax.device_get(self._scaler_state.scale))
+            sq = sum(float(np.dot(g.ravel(), g.ravel()))
+                     for g in host_grads)
+            gnorm = float(np.sqrt(sq)) * inv
+            clip = float(self.gradient_clipping() or 0.0)
+            factor = inv * (min(1.0, clip / (gnorm + 1e-6)) if clip > 0.0
+                            else 1.0)
+            if factor != 1.0:
+                for g in host_grads:
+                    np.multiply(g, np.float32(factor), out=g)
+            self._last_global_grad_norm = gnorm
             # fp32 compute must upload the fp32 masters directly — rounding
             # working params through bf16 every step would silently degrade
             # full-precision training
@@ -922,6 +933,10 @@ class DeepSpeedEngine:
                                           self._plan.param_shardings)
         else:
             self.skipped_steps += 1
+            # the skipped step's norm is the honest value for telemetry —
+            # leaving the previous step's number would make overflow steps
+            # invisible in grad-norm logs
+            self._last_global_grad_norm = float("inf")
         self._scaler_state = self.loss_scaler.update(
             self._scaler_state, jnp.asarray(found_inf))
         self.zero_grad()

@@ -255,7 +255,6 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         supports RIGHT-padded prompt batches — the usual RLHF rollout input
         (see ``InferenceEngine.generate`` for the layout contract)."""
         from deepspeed_tpu.inference.engine import (KVCacheWorkspace,
-                                                    default_prefill_chunk,
                                                     make_generate_fn,
                                                     require_right_padded,
                                                     required_cache_len)
@@ -267,7 +266,13 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         if seed is not None:
             self._gen_rng = jax.random.key(seed)
         self._gen_rng, rng = jax.random.split(self._gen_rng)
-        chunk = default_prefill_chunk(input_ids.shape[0], input_ids.shape[1])
+        # rollouts keep the ONE-PASS prefill: the in-program chunked scan
+        # carries an un-aliased partial cache copy (the form the inference
+        # engine's split-prefill path exists to avoid), and rollout
+        # prompts are short — route long-prompt/big-batch generation
+        # through InferenceEngine (the weights are a shared view) to get
+        # the split path's memory bounds
+        chunk = None
         key = (input_ids.shape[1], int(max_new_tokens), bool(do_sample),
                float(temperature), int(top_k), float(top_p),
                attention_mask is not None, chunk)

@@ -6,6 +6,7 @@ import argparse
 import time
 
 import numpy as np
+from deepspeed_tpu.utils.jax_compat import shard_map as _shard_map
 
 
 def bench_collective(op_name, sizes_mb, iters=10):
@@ -23,17 +24,17 @@ def bench_collective(op_name, sizes_mb, iters=10):
         elems = max(n, (elems // n) * n)
         x = jnp.ones((elems,), jnp.float32)
         if op_name == "all_reduce":
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(_shard_map(
                 lambda v: dist.all_reduce(v, group=DP_AXES),
                 mesh=topo.mesh, in_specs=(P(DP_AXES),), out_specs=P(DP_AXES),
                 check_vma=False))
         elif op_name == "all_gather":
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(_shard_map(
                 lambda v: dist.all_gather_into_tensor(v, group=DP_AXES),
                 mesh=topo.mesh, in_specs=(P(DP_AXES),), out_specs=P(None),
                 check_vma=False))
         elif op_name == "reduce_scatter":
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(_shard_map(
                 lambda v: dist.reduce_scatter_tensor(v, group=DP_AXES),
                 mesh=topo.mesh, in_specs=(P(None),), out_specs=P(DP_AXES),
                 check_vma=False))

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from deepspeed_tpu.comm.backend import XlaBackend
+from deepspeed_tpu.utils.jax_compat import axis_size as _axis_size
 from deepspeed_tpu.utils.comms_logging import CommsLogger, get_msg_size_from_args
 from deepspeed_tpu.utils.logging import logger
 from deepspeed_tpu.parallel import topology as topo
@@ -131,7 +132,7 @@ def axis_index(group):
     axes = _axes(group)
     idx = lax.axis_index(axes[0])
     for ax in axes[1:]:
-        idx = idx * lax.axis_size(ax) + lax.axis_index(ax)
+        idx = idx * _axis_size(ax) + lax.axis_index(ax)
     return idx
 
 
@@ -219,8 +220,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, async_op=False, log_name=Non
         if op == ReduceOp.PRODUCT:
             return jnp.exp(lax.psum(jnp.log(tensor), axes))
         raise ValueError(f"unsupported op {op}")
-    from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(jnp.asarray(tensor))
+    from deepspeed_tpu.utils.jax_compat import process_allgather_stacked
+    gathered = process_allgather_stacked(jnp.asarray(tensor))
     reducers = {ReduceOp.SUM: jnp.sum, ReduceOp.AVG: jnp.mean,
                 ReduceOp.MAX: jnp.max, ReduceOp.MIN: jnp.min,
                 ReduceOp.PRODUCT: jnp.prod}
@@ -409,8 +410,8 @@ _pending_send = []      # [(opaque_trace_state, tensor, dst, axes, tag)]
 
 
 def _current_trace_state():
-    from jax import core
-    return core.get_opaque_trace_state()
+    from deepspeed_tpu.utils.jax_compat import get_opaque_trace_state
+    return get_opaque_trace_state()
 
 
 def _prune_dead_sends():

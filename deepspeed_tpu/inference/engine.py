@@ -35,6 +35,7 @@ from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.parallel import topology as topo_mod
 from deepspeed_tpu.runtime.zero.partition import build_sharding_plan
 from deepspeed_tpu.runtime.config import ZeroConfig
+from deepspeed_tpu.tools.lint.hotpath import hot_path
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
@@ -262,6 +263,7 @@ class InferenceEngine:
         c = min(int(cfg), 512)
         return c if c < prompt_len else None
 
+    @hot_path("inference.generate")
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=-1, seed=None,
                  attention_mask=None):
@@ -334,6 +336,7 @@ class InferenceEngine:
         if ck not in self._compiled:
             module, deq = self.module, self._deq
 
+            @hot_path("inference.prefill_chunk")
             def chunk_step(params, cache, chunk_ids, start, logits_at):
                 return module.apply(deq(params), chunk_ids, cache, start,
                                     method=type(module).decode,
@@ -454,7 +457,7 @@ def require_right_padded(attention_mask):
     must be RIGHT-padded (1s then 0s) and non-empty — HF tokenizers default
     decoder-only generation to LEFT padding, which would silently index
     mid-prompt logits, and an all-pad row would condition on pad logits."""
-    m = np.asarray(attention_mask)
+    m = np.asarray(attention_mask)  # tpu-lint: disable=TL001 -- API-boundary validation of the caller's (host) mask, once per generate
     if not (np.diff(m.astype(np.int8), axis=1) <= 0).all():
         raise ValueError(
             "attention_mask must be RIGHT-padded (1s then 0s per row); "
@@ -610,6 +613,7 @@ def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
     if carry_params is None:
         carry_params = param_transform is not None
 
+    @hot_path("inference.decode")
     def generate(params, cache, input_ids, rng, eos_id,
                  attention_mask=None, prefill_logits=None):
         deq = param_transform if param_transform is not None else (lambda p: p)

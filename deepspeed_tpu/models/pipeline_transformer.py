@@ -59,7 +59,9 @@ class EmbedPipe(nn.Module):
             x = _norm(cfg, "embed_norm")(x)
         x = x.astype(cfg.jnp_dtype)
         if self.carry_aux:
-            return x, jnp.zeros((), jnp.float32)
+            # rank-1, not scalar: jax 0.4.x shard_map mis-specs scalar
+            # cotangents when transposing the pipeline region (_SpecError)
+            return x, jnp.zeros((1,), jnp.float32)
         return x
 
 
@@ -196,7 +198,7 @@ def make_lm_loss(config: TransformerConfig):
         if carry_aux:
             logits, aux = out
             return cross_entropy_loss(logits, labels) \
-                + config.moe_aux_coef * aux
+                + config.moe_aux_coef * jnp.sum(aux)
         return cross_entropy_loss(out, labels)
 
     return lm_loss

@@ -26,6 +26,20 @@ class OnebitAdamState(NamedTuple):
     error_feedback: Any
 
 
+def sign_compress(corrected):
+    """Sign-compress a pytree against ONE flat-buffer scale, ``‖buf‖₂/√n``
+    (reference ``nccl.py:54`` compressed_allreduce normalizes its flat worker
+    chunk the same way).  A per-leaf ``mean|·|`` scale hands small-variance
+    coordinates outsize ``m/√v`` steps that the error-feedback loop then
+    amplifies — at short freeze_steps that diverges within a few updates.
+    Returns ``(compressed_tree, scale)``."""
+    leaves = jax.tree.leaves(corrected)
+    sumsq = sum(jnp.sum(jnp.square(l)) for l in leaves)
+    n = sum(l.size for l in leaves)
+    scale = jnp.sqrt(sumsq / n)
+    return jax.tree.map(lambda c: jnp.sign(c) * scale, corrected), scale
+
+
 class OnebitAdam:
 
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
@@ -52,16 +66,19 @@ class OnebitAdam:
         bc1 = 1.0 - b1 ** step
         bc2 = 1.0 - b2 ** jnp.minimum(step, float(self.freeze_step))
 
-        def leaf(p, g, m, v, e):
-            g32 = g.astype(self.master_dtype)
-            p32 = p.astype(self.master_dtype)
-            m_new = b1 * m + (1.0 - b1) * g32
-            # compression stage (post-warmup): sign × mean|.| with error feedback
-            corrected = m_new + e
-            scale = jnp.mean(jnp.abs(corrected))
-            compressed = jnp.sign(corrected) * scale
-            e_new = jnp.where(warmup, e, corrected - compressed)
-            m_eff = jnp.where(warmup, m_new, compressed)
+        md = self.master_dtype
+        m_new = jax.tree.map(lambda m, g: b1 * m + (1.0 - b1) * g.astype(md),
+                             state.exp_avg, grads)
+        # compression stage (post-warmup): flat-buffer sign compression with
+        # error feedback
+        corrected = jax.tree.map(jnp.add, m_new, state.error_feedback)
+        compressed, _ = sign_compress(corrected)
+
+        def leaf(p, g, m_n, c, q, v, e):
+            g32 = g.astype(md)
+            p32 = p.astype(md)
+            e_new = jnp.where(warmup, e, c - q)
+            m_eff = jnp.where(warmup, m_n, q)
             # variance frozen after warmup (reference adam.py freeze)
             v_new = jnp.where(warmup, b2 * v + (1.0 - b2) * (g32 * g32), v)
             upd = (m_eff / bc1) / (jnp.sqrt(v_new / bc2) + eps)
@@ -69,8 +86,8 @@ class OnebitAdam:
                 upd = upd + wd * p32
             return (p32 - lr * upd).astype(p.dtype), m_eff, v_new, e_new
 
-        out = jax.tree.map(leaf, params, grads, state.exp_avg, state.exp_avg_sq,
-                           state.error_feedback)
+        out = jax.tree.map(leaf, params, grads, m_new, corrected, compressed,
+                           state.exp_avg_sq, state.error_feedback)
         is_t = lambda t: isinstance(t, tuple)
         pick = lambda i: jax.tree.map(lambda t: t[i], out, is_leaf=is_t)
         return pick(0), OnebitAdamState(pick(1), pick(2), pick(3))
@@ -121,23 +138,25 @@ class ZeroOneAdam(OnebitAdam):
         bc1 = 1.0 - b1 ** step
         bc2 = 1.0 - b2 ** jnp.minimum(step, float(self.freeze_step))
 
-        def leaf(p, g, m, v, e):
-            g32 = g.astype(self.master_dtype)
-            p32 = p.astype(self.master_dtype)
-            m_new = b1 * m + (1.0 - b1) * g32
-            # compression is always on in 0/1 Adam
-            corrected = m_new + e
-            scale = jnp.mean(jnp.abs(corrected))
-            compressed = jnp.sign(corrected) * scale
-            e_new = corrected - compressed
+        md = self.master_dtype
+        m_new = jax.tree.map(lambda m, g: b1 * m + (1.0 - b1) * g.astype(md),
+                             state.exp_avg, grads)
+        # compression is always on in 0/1 Adam
+        corrected = jax.tree.map(jnp.add, m_new, state.error_feedback)
+        compressed, _ = sign_compress(corrected)
+
+        def leaf(p, g, c, q, v):
+            g32 = g.astype(md)
+            p32 = p.astype(md)
+            e_new = c - q
             v_new = jnp.where(refresh, b2 * v + (1.0 - b2) * (g32 * g32), v)
-            upd = (compressed / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            upd = (q / bc1) / (jnp.sqrt(v_new / bc2) + eps)
             if wd != 0.0:
                 upd = upd + wd * p32
-            return (p32 - lr * upd).astype(p.dtype), compressed, v_new, e_new
+            return (p32 - lr * upd).astype(p.dtype), q, v_new, e_new
 
-        out = jax.tree.map(leaf, params, grads, state.exp_avg, state.exp_avg_sq,
-                           state.error_feedback)
+        out = jax.tree.map(leaf, params, grads, corrected, compressed,
+                           state.exp_avg_sq)
         is_t = lambda t: isinstance(t, tuple)
         pick = lambda i: jax.tree.map(lambda t: t[i], out, is_leaf=is_t)
         return pick(0), OnebitAdamState(pick(1), pick(2), pick(3))

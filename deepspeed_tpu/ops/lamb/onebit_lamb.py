@@ -4,17 +4,21 @@
 Algorithm (Li et al., "1-bit LAMB"): exact LAMB during ``freeze_step`` warmup;
 afterwards the variance term and the per-tensor LAMB trust ratios are frozen
 (the reference caches ``lamb_coeffs`` at the freeze boundary) and the momentum
-is communicated compressed — modeled here as sign × mean-magnitude with an
-error-feedback buffer, the same update rule the reference applies after its
-compressed allreduce (``runtime/comm/nccl.py:54``).  Post-freeze, the frozen
-trust ratio is scaled by the ratio of current to frozen momentum scale
-(reference's ``scaling_coeff`` update).
+is communicated compressed — modeled here as sign compression against ONE
+flat-buffer ``‖·‖₂/√n`` scale shared with 1-bit Adam (``sign_compress``; the
+reference normalizes its flat allreduce chunk the same way,
+``runtime/comm/nccl.py:54``) with an error-feedback buffer.  Post-freeze, the
+frozen trust ratio is scaled by the drift of that global momentum scale
+(reference's ``scaling_coeff`` update — per-tensor there, global here) and
+capped by the live trust ratio so the step norm stays within ``lr·‖w‖``.
 """
 
 from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
+
+from deepspeed_tpu.ops.adam.onebit_adam import sign_compress
 
 
 class OnebitLambState(NamedTuple):
@@ -62,14 +66,17 @@ class OnebitLamb:
         bc1 = 1.0 - b1 ** step
         bc2 = 1.0 - b2 ** jnp.minimum(step, float(self.freeze_step))
 
-        def leaf(p, g, m, v, e, coeff, mscale):
-            g32 = g.astype(self.master_dtype)
-            p32 = p.astype(self.master_dtype)
-            m_new = b1 * m + (1.0 - b1) * g32
-            # post-freeze: compressed momentum (sign × scale, error feedback)
-            corrected = m_new + e
-            scale = jnp.mean(jnp.abs(corrected))
-            compressed = jnp.sign(corrected) * scale
+        md = self.master_dtype
+        m_tree = jax.tree.map(lambda m, g: b1 * m + (1.0 - b1) * g.astype(md),
+                              state.exp_avg, grads)
+        # post-freeze: compressed momentum (flat-buffer sign compression with
+        # error feedback, shared with 1-bit Adam)
+        corrected_tree = jax.tree.map(jnp.add, m_tree, state.error_feedback)
+        compressed_tree, scale = sign_compress(corrected_tree)
+
+        def leaf(p, g, m_new, corrected, compressed, v, e, coeff, mscale):
+            g32 = g.astype(md)
+            p32 = p.astype(md)
             e_new = jnp.where(warmup, e, corrected - compressed)
             m_eff = jnp.where(warmup, m_new, compressed)
             v_new = jnp.where(warmup, b2 * v + (1.0 - b2) * (g32 * g32), v)
@@ -90,13 +97,21 @@ class OnebitLamb:
                                    jnp.maximum(scale, 1e-12), mscale)
             drift = jnp.clip(scale / jnp.maximum(mscale, 1e-12),
                              self.factor_min, self.factor_max)
-            eff_coeff = jnp.where(warmup, live, coeff_new * drift)
+            # cap the frozen coeff by the LIVE trust ratio: a coeff frozen
+            # early can't shrink when the compressed update norm grows, so
+            # without the cap the step norm is unbounded (lr·coeff·u_norm);
+            # with it the step never exceeds lr·w_norm
+            live_cap = jnp.where(w_norm > 0,
+                                 w_norm / jnp.maximum(u_norm, 1e-12), 1.0)
+            eff_coeff = jnp.where(warmup, live,
+                                  jnp.minimum(coeff_new * drift, live_cap))
             return ((p32 - lr * eff_coeff * upd).astype(p.dtype),
                     m_eff, v_new, e_new, coeff_new, mscale_new)
 
-        out = jax.tree.map(leaf, params, grads, state.exp_avg,
-                           state.exp_avg_sq, state.error_feedback,
-                           state.frozen_lamb_coeff, state.frozen_m_scale)
+        out = jax.tree.map(leaf, params, grads, m_tree, corrected_tree,
+                           compressed_tree, state.exp_avg_sq,
+                           state.error_feedback, state.frozen_lamb_coeff,
+                           state.frozen_m_scale)
         is_t = lambda t: isinstance(t, tuple)
         pick = lambda i: jax.tree.map(lambda t: t[i], out, is_leaf=is_t)
         return pick(0), OnebitLambState(pick(1), pick(2), pick(3), pick(4),

@@ -27,6 +27,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 import os as _os
+from deepspeed_tpu.utils.jax_compat import CompilerParams as _CompilerParams
 
 # tuned on v5e at seq 2048/head_dim 64: large kv blocks amortize the
 # VPU-bound online-softmax bookkeeping; q=512 beats 256 and 1024 on the
@@ -193,7 +194,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(q, k, v)
@@ -399,7 +400,7 @@ def _bwd(scale, causal, block_q, block_k, block_q_bwd, block_k_bwd, res, do):
         out_specs=pl.BlockSpec((1, 1, block_q, D), q_map),
         out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
@@ -441,7 +442,7 @@ def _bwd(scale, causal, block_q, block_k, block_q_bwd, block_k_bwd, res, do):
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)

@@ -47,6 +47,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.parallel.topology import PP_AXIS
+from deepspeed_tpu.utils.jax_compat import shard_map as _shard_map
 
 
 def spmd_pipeline(stage_fn, stacked_params, x0, num_micro, mesh,
@@ -119,7 +120,7 @@ def spmd_pipeline(stage_fn, stacked_params, x0, num_micro, mesh,
         return outs
 
     in_specs = (jax.tree.map(lambda _: P(pp_axis), stacked_params), P())
-    out = jax.shard_map(
+    out = _shard_map(
         region, mesh=mesh, in_specs=in_specs, out_specs=P(),
         axis_names=frozenset({pp_axis}), check_vma=False,
     )(stacked_params, x0)
@@ -379,7 +380,7 @@ def spmd_pipeline_1f1b(stage_fn, stacked_params, first_fn, first_params,
                 P(), P(), P(), P(), P())
     out_specs = (P(), jax.tree.map(lambda _: P(pp_axis), stacked_params),
                  P(), P())
-    return jax.shard_map(
+    return _shard_map(
         region, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         axis_names=frozenset({pp_axis}), check_vma=False,
     )(stacked_params, first_params, last_params, inputs, labels,

@@ -147,18 +147,43 @@ def _remat_kwargs():
     return {"policy": pol}
 
 
+# wrapped-callable cache: rebuilding jax.jit per checkpoint() call would
+# give every call an empty compilation cache (a full retrace+compile per
+# training step on the eager path).  Bounded LRU (a weak-keyed dict cannot
+# work here: the wrapper's closure references the function, so entries
+# would be immortal); fresh per-call closures at worst cycle the LRU.
+def _config_key():
+    return (_config.get("policy"), bool(_config.get("cpu_checkpointing")))
+
+
+@functools.lru_cache(maxsize=64)
+def _build_wrapped(function, cfg_key):
+    del cfg_key            # part of the cache key; _remat_kwargs reads live config
+    fn = jax.checkpoint(function, **_remat_kwargs())
+    if _config.get("cpu_checkpointing"):
+        # the host-offload policy's TransferToMemoryKind is only legal under
+        # jit; wrapping is free inside an outer jit (inlined) and makes the
+        # eager/grad-only path legal too
+        fn = jax.jit(fn)
+    return fn
+
+
+def _wrapped(function):
+    return _build_wrapped(function, _config_key())
+
+
 def checkpoint(function, *args, **kwargs):
     """Remat'd call of ``function(*args)`` (reference ``checkpoint() :708``).
 
     Unlike the reference this is traceable — it can (and should) be used
     inside jitted train steps; XLA schedules the recompute.
     """
-    return jax.checkpoint(function, **_remat_kwargs())(*args, **kwargs)
+    return _wrapped(function)(*args, **kwargs)
 
 
 def checkpoint_wrapper(function):
     """Decorator form: returns a remat'd version of ``function``."""
-    return functools.wraps(function)(jax.checkpoint(function, **_remat_kwargs()))
+    return functools.wraps(function)(_wrapped(function))
 
 
 def partition_activations_in_checkpoint(partition_activation):

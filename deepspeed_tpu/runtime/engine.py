@@ -51,6 +51,7 @@ from deepspeed_tpu.runtime.fp16.loss_scaler import create_loss_scaler
 from deepspeed_tpu.runtime.lr_schedules import build_lr_scheduler
 from deepspeed_tpu.runtime.optimizers import build_optimizer
 from deepspeed_tpu.runtime.zero.partition import build_sharding_plan
+from deepspeed_tpu.tools.lint.hotpath import hot_path
 from deepspeed_tpu.utils.logging import logger, log_dist
 from deepspeed_tpu.utils.timer import (SynchronizedWallClockTimer, ThroughputTimer,
                                        FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
@@ -147,7 +148,8 @@ class DeepSpeedEngine:
         self.global_steps = 0
         self.global_samples = 0
         self.micro_steps = 0
-        self.skipped_steps = 0
+        self._skipped_steps = 0
+        self._pending_inf_flags = []   # device overflow flags, drained lazily
         self.training = True
         self._params = None            # master (fp32) param pytree, sharded
         self._opt_state = None
@@ -470,6 +472,7 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------ #
     # forward / backward / step
     # ------------------------------------------------------------------ #
+    @hot_path("runtime.fwd_bwd")
     def _fwd_bwd_core(self, params, scale, rng, *args, **kwargs):
         """Traced body shared by ``_get_fwd_bwd`` (fresh grads) and
         ``_get_fwd_bwd_acc`` (fused accumulate)."""
@@ -495,7 +498,7 @@ class DeepSpeedEngine:
     def _get_fwd_bwd(self):
         key = "fwd_bwd"
         if key not in self._compiled:
-            self._compiled[key] = jax.jit(
+            self._compiled[key] = jax.jit(  # tpu-lint: disable=TL002 -- params must stay live: the same buffers feed every micro-step and the optimizer step
                 self._fwd_bwd_core,
                 out_shardings=(self._plan.grad_shardings,
                                NamedSharding(self.mesh, P()),
@@ -614,6 +617,7 @@ class DeepSpeedEngine:
         if key not in self._compiled:
             fwd_bwd_core = self._fwd_bwd_core
 
+            @hot_path("runtime.fwd_bwd_acc")
             def fwd_bwd_acc(params, acc, scale, rng, *args, **kwargs):
                 grads, loss, found_inf = fwd_bwd_core(params, scale, rng,
                                                       *args, **kwargs)
@@ -633,7 +637,7 @@ class DeepSpeedEngine:
         if key not in self._compiled:
             def fwd(params, rng, *args, **kwargs):
                 return self._apply_model(params, args, kwargs, rng, train=False)
-            self._compiled[key] = jax.jit(fwd)
+            self._compiled[key] = jax.jit(fwd)  # tpu-lint: disable=TL002 -- eval forward: params are read-only and stay live for the next step
         return self._compiled[key]
 
     def _get_accum(self):
@@ -696,6 +700,7 @@ class DeepSpeedEngine:
                 output_file=pcfg.output_file,
                 batch=getattr(self, "_profile_batch", None))
 
+    @hot_path("runtime.forward")
     def forward(self, *args, **kwargs):
         self._lazy_init(args, kwargs)
         args = tuple(self._curriculum_slice(a, 1) if _is_batch_like(a) else a
@@ -805,6 +810,26 @@ class DeepSpeedEngine:
             self.timers(BACKWARD_GLOBAL_TIMER).stop()
         return loss
 
+    @property
+    def skipped_steps(self):
+        """Overflow-skipped step count; reading drains any pending device
+        flags in one batched transfer (the per-step flag is never synced on
+        the hot path — see step())."""
+        self._drain_skipped_steps()
+        return self._skipped_steps
+
+    @skipped_steps.setter
+    def skipped_steps(self, value):
+        self._pending_inf_flags = []
+        self._skipped_steps = int(value)
+
+    def _drain_skipped_steps(self):  # tpu-lint: disable=TL001 -- this IS the amortized sync point: one batched read for all queued flags
+        if self._pending_inf_flags:
+            flags, self._pending_inf_flags = self._pending_inf_flags, []
+            # device_get batches the list itself — a jnp.stack would compile
+            # a fresh N-scalar program per distinct queue length
+            self._skipped_steps += int(np.sum(jax.device_get(flags)))
+
     def is_gradient_accumulation_boundary(self):
         return self.micro_steps % self.gradient_accumulation_steps() == 0
 
@@ -818,6 +843,7 @@ class DeepSpeedEngine:
             clip = float(self.gradient_clipping() or 0.0)
             scaler = self.loss_scaler
 
+            @hot_path("runtime.apply_update")
             def apply_update(params, opt_state, scaler_state, grads, found_inf, lr, step):
                 grads, gnorm = _unscale_and_clip(grads, scaler_state.scale, clip)
                 new_params, new_opt = self.optimizer.update(grads, opt_state, params,
@@ -837,6 +863,7 @@ class DeepSpeedEngine:
                                None, None))
         return self._compiled[key]
 
+    @hot_path("runtime.step")
     def step(self, lr_kwargs=None):
         """Optimizer step at the accumulation boundary (reference
         ``engine.py:2000`` / ``_take_model_step:1935``)."""
@@ -864,19 +891,28 @@ class DeepSpeedEngine:
         if self.lr_scheduler is not None:
             self.lr_scheduler.step(**(lr_kwargs or {}))
         if self.fp16_enabled() and found_inf_acc is not None:
-            # surface skipped steps for parity with reference loss-scale logs
-            # (host sync; fp16-only so the bf16 hot path stays async)
-            if bool(jax.device_get(found_inf_acc)):
-                self.skipped_steps += 1
-                log_dist(f"overflow: skipping step, new loss scale "
-                         f"{float(jax.device_get(self._scaler_state.scale))}", ranks=[0])
+            # surface skipped steps for parity with reference loss-scale
+            # logs — but do NOT read the flag here: that host sync would
+            # serialize every fp16 step.  Flags queue on device and drain
+            # in one batched read at the logging boundary (or whenever
+            # skipped_steps is read, e.g. checkpoint save).
+            self._pending_inf_flags.append(found_inf_acc)
+            if self.global_steps % self.steps_per_print() == 0:
+                before = self._skipped_steps
+                self._drain_skipped_steps()
+                if self._skipped_steps > before:
+                    log_dist(
+                        f"overflow: skipped {self._skipped_steps - before} "
+                        f"recent step(s), new loss scale "
+                        f"{float(jax.device_get(self._scaler_state.scale))}",  # tpu-lint: disable=TL001 -- print-gated, amortized over steps_per_print
+                        ranks=[0])
         self.tput_timer.stop(global_step=True)
         self._maybe_finish_profiler()
         if self.monitor.enabled and self.global_steps % self.steps_per_print() == 0:
             events = [("Train/Samples/lr", self.get_lr()[0], self.global_samples)]
             if self._last_loss is not None:
                 events.append(("Train/Samples/train_loss",
-                               float(jax.device_get(self._last_loss)), self.global_samples))
+                               float(jax.device_get(self._last_loss)), self.global_samples))  # tpu-lint: disable=TL001 -- monitor read, gated on steps_per_print
             self.monitor.write_events(events)
         if self.wall_clock_breakdown():
             self.timers(STEP_GLOBAL_TIMER).stop()
@@ -884,7 +920,7 @@ class DeepSpeedEngine:
                 self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
                                  STEP_GLOBAL_TIMER])
 
-    def _offload_step(self, lr_kwargs=None):
+    def _offload_step(self, lr_kwargs=None):  # tpu-lint: disable=TL001 -- ZeRO-Offload: grads cross to the host BY DESIGN (see docstring)
         """Host optimizer step (ZeRO-Offload): host-side unscale/clip ->
         host C++ Adam -> upload (reference stage_1_and_2.py:1630 CPU Adam
         step + :1750 updated-param gather).  The unscale + global-norm
@@ -962,8 +998,9 @@ class DeepSpeedEngine:
             # overflow skip, so only scale==1.0 takes the fast path.
             from deepspeed_tpu.runtime.fp16.loss_scaler import StaticLossScaler
             static_scale = isinstance(scaler, StaticLossScaler) and \
-                float(scaler.scale_value) == 1.0
+                float(scaler.scale_value) == 1.0  # tpu-lint: disable=TL001 -- python attribute of the host-side scaler, runs once per compile
 
+            @hot_path("runtime.train_step")
             def train_step(params, opt_state, scaler_state, lr, step, rng, batches):
                 # derive this step's stream on-device: the caller passes the
                 # same base key every step (no per-step host-side split op)
@@ -1019,6 +1056,7 @@ class DeepSpeedEngine:
                                None, None, None))
         return self._compiled[key]
 
+    @hot_path("runtime.train_batch")
     def train_batch(self, data_iter=None, batch=None):
         """One full global-batch step as a single XLA program (analog of
         ``PipelineEngine.train_batch``, reference ``pipe/engine.py:286``, for
@@ -1076,7 +1114,7 @@ class DeepSpeedEngine:
             # loss here syncs, but only every steps_per_print steps
             self.monitor.write_events(
                 [("Train/Samples/lr", self.get_lr()[0], self.global_samples),
-                 ("Train/Samples/train_loss", float(jax.device_get(loss)),
+                 ("Train/Samples/train_loss", float(jax.device_get(loss)),  # tpu-lint: disable=TL001 -- monitor read, gated on steps_per_print
                   self.global_samples)])
         return loss
 

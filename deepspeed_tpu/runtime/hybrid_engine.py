@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.tools.lint.hotpath import hot_path
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
@@ -153,6 +154,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                 rep = NamedSharding(self.mesh, P())
                 q = self._rollout_quantizer
 
+                @hot_path("hybrid.rollout_cast")
                 def quantize_and_cast(t):
                     t = q.quantize_tree(t)
                     return jax.tree.map(
@@ -248,6 +250,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
     # ------------------------------------------------------------------ #
     # Rollout generation (reference hybrid_engine.generate :178)
     # ------------------------------------------------------------------ #
+    @hot_path("hybrid.rollout_generate")
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=-1,
                  seed=None, attention_mask=None):
@@ -305,12 +308,12 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
             args += (jnp.asarray(attention_mask),)
         out, cache = self._gen_compiled[key](*args)
         self._gen_workspace.give_back(cache)
-        out.block_until_ready()
+        out.block_until_ready()  # tpu-lint: disable=TL001 -- rollout latency metric needs the full program, once per rollout not per token
         self._generate_latency += time.time() - t0
         return out
 
 
-@partial(jax.jit, static_argnames=("sign",))
+@partial(jax.jit, static_argnames=("sign",))  # tpu-lint: disable=TL002 -- input is the live master tree; donating it would kill the training copy
 def _fuse_lora_jit(params, lora_spec, sign):
     from deepspeed_tpu.runtime.zero.partition import path_to_str
 

@@ -1,0 +1,6 @@
+"""Developer tooling (tpu-lint and friends).
+
+The linter itself is dev-only, but ``tools.lint.hotpath`` IS a runtime
+dependency: the engines import its (identity) ``@hot_path`` decorator to
+mark their hot paths for static analysis.
+"""

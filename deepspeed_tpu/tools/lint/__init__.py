@@ -1,0 +1,22 @@
+"""tpu-lint — framework-aware static analysis for TPU hazards.
+
+Rules (each suppressible per line or per function via
+``# tpu-lint: disable=<rule> -- reason``):
+
+* **TL001** host transfer (``.item()``, ``float()``, ``np.asarray``,
+  ``jax.device_get``, ``block_until_ready``) on a registered hot path
+* **TL002** ``jax.jit``/``pjit`` over large buffers without donation
+* **TL003** Python side effects (print / logging / global writes) inside a
+  jitted function
+* **TL004** unhashable or array-valued static args
+* **TL005** per-step config/dict string lookups on a hot path
+
+CLI: ``python -m deepspeed_tpu.tools.lint [paths]`` (or ``bin/ds_lint``);
+exits non-zero when any unsuppressed finding remains.  The companion jaxpr
+harness (:mod:`deepspeed_tpu.tools.lint.jaxpr_check`) traces registered
+runtime/inference entry points and verifies — at the compiler level — that
+they contain no host callbacks and that declared donations actually alias.
+"""
+
+from deepspeed_tpu.tools.lint.core import Finding, RULES, run_lint  # noqa: F401
+from deepspeed_tpu.tools.lint.hotpath import hot_path  # noqa: F401

@@ -1,0 +1,65 @@
+"""CLI: ``python -m deepspeed_tpu.tools.lint [paths] [options]``."""
+
+import argparse
+import json
+import os
+import sys
+
+from deepspeed_tpu.tools.lint.core import RULES, run_lint
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="tpu-lint",
+        description="Framework-aware static analysis for host-transfer, "
+                    "donation, and recompilation hazards.")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to lint (default: the "
+                             "installed deepspeed_tpu package)")
+    parser.add_argument("--rules", help="comma-separated rule ids to run "
+                                        "(default: all)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit findings as JSON")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        from deepspeed_tpu.tools.lint import rules as _r  # noqa: F401
+        for rid, check in sorted(RULES.items()):
+            print(f"{rid}  {check.title}")
+        return 0
+
+    paths = args.paths
+    if not paths:
+        # resolve the default against the installed package, not the cwd —
+        # `ds_lint` from anywhere must not silently check zero files
+        import deepspeed_tpu
+        paths = [os.path.dirname(os.path.abspath(deepspeed_tpu.__file__))]
+    rules = None
+    if args.rules:
+        from deepspeed_tpu.tools.lint import rules as _r  # noqa: F401
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(RULES)
+        if unknown:
+            print(f"tpu-lint: error: unknown rule id(s) "
+                  f"{sorted(unknown)}; known: {sorted(RULES)}",
+                  file=sys.stderr)
+            return 2
+    findings, stats = run_lint(paths, rules=rules)
+    if stats["files"] == 0:
+        print(f"tpu-lint: error: no Python files found under {paths}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f)
+        suppressed = sum(stats["suppressed"].values())
+        print(f"tpu-lint: {len(findings)} finding(s), {suppressed} "
+              f"suppressed, {stats['files']} file(s) checked")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,288 @@
+"""tpu-lint core: source model, rule registry, suppressions, call graph.
+
+The linter is purely static — ``ast`` over the package sources, no imports
+of the code under analysis.  Rules live in ``rules/`` (one module per rule)
+and register themselves with :func:`rule`; each receives a
+:class:`ModuleInfo` and yields :class:`Finding`s.  Suppression is per line::
+
+    x = loss.item()   # tpu-lint: disable=TL001 -- logged once per epoch
+
+and a suppression on a ``def`` line covers the whole function body.
+"""
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Iterator, List, Optional
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpu-lint:\s*disable=([A-Z0-9*, ]+)(?:\s*--\s*(.*))?")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    node: ast.AST                 # FunctionDef / AsyncFunctionDef / Lambda
+    qualname: str
+    name: str
+    class_name: Optional[str]
+    hot: bool                     # carries @hot_path or is nested in one
+    hot_name: Optional[str] = None
+
+    @property
+    def params(self):
+        a = self.node.args
+        names = [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+        return [n for n in names if n not in ("self", "cls")]
+
+
+class ModuleInfo:
+    """One parsed source file: tree, functions, suppressions, call graph."""
+
+    def __init__(self, path, text):
+        self.path = path
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        self.lines = text.splitlines()
+        self._suppressions = self._parse_suppressions()
+        self.functions: List[FunctionInfo] = []
+        self._collect_functions()
+        self._mark_hot_reachable()
+
+    # ---------------------------------------------------------------- #
+    # suppressions
+    # ---------------------------------------------------------------- #
+    def _parse_suppressions(self):
+        out = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        return out
+
+    def suppressed(self, line, rule_id):
+        rules = self._suppressions.get(line)
+        if rules and (rule_id in rules or "*" in rules):
+            return True
+        # a suppression on the def line (or a decorator line) covers the
+        # whole function body
+        for fn in self.functions:
+            node = fn.node
+            if not hasattr(node, "end_lineno"):
+                continue
+            decos = getattr(node, "decorator_list", [])
+            start = min([node.lineno] + [d.lineno for d in decos])
+            if start <= line <= (node.end_lineno or node.lineno):
+                for header_line in range(start, node.body[0].lineno
+                                         if node.body else node.lineno):
+                    rules = self._suppressions.get(header_line)
+                    if rules and (rule_id in rules or "*" in rules):
+                        return True
+        return False
+
+    def suppression_count(self, rule_id):
+        return sum(1 for rules in self._suppressions.values()
+                   if rule_id in rules or "*" in rules)
+
+    # ---------------------------------------------------------------- #
+    # function collection + hot-path propagation
+    # ---------------------------------------------------------------- #
+    def _collect_functions(self):
+        module = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.stack = []       # (kind, name) — 'class' or 'func'
+
+            def _qual(self, name):
+                return ".".join([n for _, n in self.stack] + [name])
+
+            def _class(self):
+                for kind, name in reversed(self.stack):
+                    if kind == "class":
+                        return name
+                return None
+
+            def visit_ClassDef(self, node):
+                self.stack.append(("class", node.name))
+                self.generic_visit(node)
+                self.stack.pop()
+
+            def _visit_func(self, node):
+                hot_name = _hot_path_decorator_name(node)
+                module.functions.append(FunctionInfo(
+                    node=node, qualname=self._qual(node.name),
+                    name=node.name, class_name=self._class(),
+                    hot=hot_name is not None, hot_name=hot_name))
+                self.stack.append(("func", node.name))
+                self.generic_visit(node)
+                self.stack.pop()
+
+            visit_FunctionDef = _visit_func
+            visit_AsyncFunctionDef = _visit_func
+
+        V().visit(self.tree)
+
+    def _mark_hot_reachable(self):
+        """Hotness propagates (a) to functions lexically nested inside a hot
+        function and (b) along same-module calls, resolved by bare name
+        (``f(...)``, ``self.f(...)``, ``obj.f(...)`` all resolve to any
+        function/method named ``f`` in this module — deliberately
+        over-approximate: a lint prefers a suppressible false positive to a
+        silent host sync)."""
+        by_name = {}
+        for fn in self.functions:
+            by_name.setdefault(fn.name, []).append(fn)
+
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions:
+                if not fn.hot:
+                    continue
+                # (a) nested defs
+                for child in ast.walk(fn.node):
+                    if child is fn.node:
+                        continue
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        for other in self.functions:
+                            if other.node is child and not other.hot:
+                                other.hot = True
+                                other.hot_name = fn.hot_name
+                                changed = True
+                # (b) called names
+                for callee in _called_names(fn.node):
+                    for other in by_name.get(callee, []):
+                        if not other.hot:
+                            other.hot = True
+                            other.hot_name = fn.hot_name
+                            changed = True
+
+    def hot_functions(self):
+        return [f for f in self.functions if f.hot]
+
+    def enclosing_function(self, node):
+        """Innermost FunctionInfo whose span contains ``node``."""
+        best = None
+        for fn in self.functions:
+            n = fn.node
+            if n.lineno <= node.lineno <= (n.end_lineno or n.lineno):
+                if best is None or n.lineno >= best.node.lineno:
+                    best = fn
+        return best
+
+
+def _hot_path_decorator_name(node):
+    for dec in getattr(node, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target)
+        if name and name.split(".")[-1] == "hot_path":
+            if isinstance(dec, ast.Call) and dec.args and \
+                    isinstance(dec.args[0], ast.Constant):
+                return str(dec.args[0].value)
+            return node.name
+    return None
+
+
+def _called_names(fn_node):
+    """Bare names of everything called inside ``fn_node`` (excluding calls
+    inside nested defs — those propagate through containment instead)."""
+    out = set()
+    nested = set()
+    for child in ast.walk(fn_node):
+        if child is not fn_node and isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(child):
+                nested.add(sub)
+    for child in ast.walk(fn_node):
+        if child in nested or not isinstance(child, ast.Call):
+            continue
+        f = child.func
+        if isinstance(f, ast.Name):
+            out.add(f.id)
+        elif isinstance(f, ast.Attribute):
+            out.add(f.attr)
+    return out
+
+
+def dotted_name(node):
+    """'jax.jit' for Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# -------------------------------------------------------------------- #
+# rule registry
+# -------------------------------------------------------------------- #
+RULES = {}
+
+
+def rule(rule_id, title):
+    """Register ``check(module: ModuleInfo) -> Iterator[Finding]``."""
+    def register(check):
+        check.rule_id = rule_id
+        check.title = title
+        RULES[rule_id] = check
+        return check
+    return register
+
+
+def iter_python_files(paths):
+    for path in paths:
+        if os.path.isfile(path) and path.endswith(".py"):
+            yield path
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def run_lint(paths, rules=None):
+    """Lint ``paths``; returns (findings, stats).
+
+    ``stats``: {"files": n, "suppressed": {rule_id: count}}.
+    """
+    from deepspeed_tpu.tools.lint import rules as _rules  # noqa: F401 — registers
+    selected = {k: v for k, v in RULES.items()
+                if rules is None or k in rules}
+    findings, stats = [], {"files": 0, "suppressed": {}}
+    for path in iter_python_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                module = ModuleInfo(path, fh.read())
+        except SyntaxError as e:
+            findings.append(Finding("TL000", path, e.lineno or 1, 0,
+                                    f"syntax error: {e.msg}"))
+            continue
+        stats["files"] += 1
+        for rule_id, check in sorted(selected.items()):
+            for f in check(module):
+                if module.suppressed(f.line, rule_id):
+                    stats["suppressed"][rule_id] = \
+                        stats["suppressed"].get(rule_id, 0) + 1
+                else:
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, stats

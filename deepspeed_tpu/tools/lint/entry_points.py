@@ -1,0 +1,155 @@
+"""Registered jaxpr-check entry points: the REAL hot paths, tiny-sized.
+
+Each builder returns an :class:`EntryPoint` wrapping the jit-wrapped
+callable the engine itself dispatches per step (the fused train step, the
+generation/decode loop, the split-prefill chunk program) plus concrete CPU
+args to trace it with, and whether the program is expected to declare buffer
+donation.  Runs entirely on CPU (``JAX_PLATFORMS=cpu``) at toy shapes —
+tracing and lowering exercise everything the checks need.
+"""
+
+import dataclasses
+from typing import Any, Callable, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class EntryPoint:
+    name: str
+    fn: Callable            # jit-wrapped callable
+    args: Tuple[Any, ...]
+    expect_donation: bool   # program must declare (and use) buffer donation
+    # minimum number of donated inputs that must actually alias an output.
+    # When set, the "donated buffers were not usable" warning is tolerated —
+    # for programs that deliberately donate CONSUMED inputs (e.g. grads,
+    # freed for scratch reuse) the warning is expected; the count is what
+    # guards the state buffers' aliasing.
+    min_aliased: int = 0
+
+
+def _tiny_train_engine():
+    import flax.linen as nn
+    import deepspeed_tpu
+
+    class TinyModel(nn.Module):
+        @nn.compact
+        def __call__(self, batch):
+            x, y = batch["x"], batch["y"]
+            h = nn.relu(nn.Dense(16, name="l0")(x))
+            logits = nn.Dense(16, name="head")(h)
+            one_hot = jax.nn.one_hot(y, 16)
+            return -jnp.mean(jnp.sum(
+                jax.nn.log_softmax(logits) * one_hot, axis=-1))
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=TinyModel(),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0}})
+    return engine
+
+
+def runtime_train_step():
+    """The fused train step ``runtime/engine.py`` dispatches per
+    ``train_batch`` (params/opt_state/scaler donated)."""
+    engine = _tiny_train_engine()
+    rng = np.random.default_rng(0)
+    micro = {"x": jnp.asarray(rng.standard_normal((2, 16)), jnp.float32),
+             "y": jnp.asarray(rng.integers(0, 16, (2,)), jnp.int32)}
+    batch = jax.tree.map(lambda x: x[None], micro)     # [gas=1, ...]
+    engine._lazy_init((micro,), {})
+    fused = engine._get_fused_step()
+    args = (engine._params, engine._opt_state, engine._scaler_state,
+            jnp.asarray(1e-3, jnp.float32), jnp.asarray(1, jnp.int32),
+            engine._rng, batch)
+    return EntryPoint("runtime.train_step", fused, args, expect_donation=True)
+
+
+def runtime_apply_update():
+    """The 3-call path's optimizer step (params/opt_state/scaler/grads all
+    donated; grads are CONSUMED — their donation never aliases, so the check
+    demands the params+opt_state aliasing count instead of a clean warning
+    log)."""
+    engine = _tiny_train_engine()
+    rng = np.random.default_rng(0)
+    micro = {"x": jnp.asarray(rng.standard_normal((2, 16)), jnp.float32),
+             "y": jnp.asarray(rng.integers(0, 16, (2,)), jnp.int32)}
+    engine._lazy_init((micro,), {})
+    apply = engine._get_apply()
+    grads = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                         engine._params)
+    args = (engine._params, engine._opt_state, engine._scaler_state, grads,
+            jnp.asarray(False), jnp.asarray(1e-3, jnp.float32),
+            jnp.asarray(1, jnp.int32))
+    n_state = len(jax.tree.leaves((engine._params, engine._opt_state)))
+    return EntryPoint("runtime.apply_update", apply, args,
+                      expect_donation=True, min_aliased=n_state)
+
+
+def _tiny_inference_engine(prefill_chunk=None):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import (Transformer,
+                                                  TransformerConfig)
+    cfg = TransformerConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                            num_heads=4, max_seq_len=64,
+                            use_flash_attention=False, dtype="float32")
+    model = Transformer(cfg)
+    config = {"dtype": "float32"}
+    if prefill_chunk is not None:
+        config["prefill_chunk_size"] = prefill_chunk
+    engine = deepspeed_tpu.init_inference(model, config=config)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 97, (1, 8)),
+                      jnp.int32)
+    params = model.init(jax.random.key(0), {"input_ids": ids})
+    engine.set_params(params)
+    return engine
+
+
+def inference_decode():
+    """The generation program (prefill + decode scan) ``inference/engine.py``
+    dispatches per ``generate`` — the KV cache is donated through it."""
+    from deepspeed_tpu.inference.engine import required_cache_len
+    engine = _tiny_inference_engine()
+    B, P, T = 1, 8, 4
+    ids = jnp.asarray(np.random.default_rng(1).integers(0, 97, (B, P)),
+                      jnp.int32)
+    fn = engine._get_generate(P, T, False, 1.0, 0, 1.0, with_mask=False,
+                              prefill_chunk=None)
+    cache = engine._workspace.take(B, required_cache_len(P, T, None),
+                                   engine.compute_dtype)
+    args = (engine._params, cache, ids, jax.random.key(0),
+            jnp.asarray(-1))
+    return EntryPoint("inference.decode", fn, args, expect_donation=True)
+
+
+def inference_prefill_chunk():
+    """The split-prefill per-chunk program (donated-cache; the round-5 OOM
+    fix) — built by driving a real chunked ``generate`` and re-tracing the
+    compiled chunk function."""
+    engine = _tiny_inference_engine(prefill_chunk=8)
+    B, P, C, T = 1, 24, 8, 2
+    ids = jnp.asarray(np.random.default_rng(2).integers(0, 97, (B, P)),
+                      jnp.int32)
+    engine.generate(ids, max_new_tokens=T, seed=0)
+    key = next(k for k in engine._compiled
+               if isinstance(k, tuple) and k and k[0] == "chunkfill")
+    chunk_fn = engine._compiled[key]
+    cache = engine._workspace.take(B, 64, engine.compute_dtype)
+    args = (engine._params, cache, ids[:, :C],
+            jnp.asarray(0, jnp.int32), jnp.zeros((B,), jnp.int32))
+    return EntryPoint("inference.prefill_chunk", chunk_fn, args,
+                      expect_donation=True)
+
+
+BUILDERS = (runtime_train_step, runtime_apply_update, inference_decode,
+            inference_prefill_chunk)
+
+
+def iter_entry_points():
+    for build in BUILDERS:
+        yield build()

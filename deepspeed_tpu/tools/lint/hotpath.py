@@ -1,0 +1,26 @@
+"""Hot-path registry for tpu-lint.
+
+``@hot_path("name")`` marks a function as a TPU hot path: a train step,
+decode loop, prefill chunk, or anything else that runs once per training or
+serving step.  The decorator is an IDENTITY at runtime (zero overhead — it
+runs once at definition time and returns the function unchanged); its value
+is to the static analyzer, which treats every marked function AND everything
+lexically nested in or (heuristically) called from one as hot when applying
+host-transfer and caching rules (TL001, TL005).
+
+Kept in its own module with no linter imports so the runtime engines can
+import it for free.
+"""
+
+# (name, module, qualname) of every hot path registered this process —
+# consumed by the jaxpr harness and by `python -m deepspeed_tpu.tools.lint
+# --hot-paths` for debugging.
+REGISTERED = []
+
+
+def hot_path(name):
+    """Mark the decorated function as a TPU hot path named ``name``."""
+    def mark(fn):
+        REGISTERED.append((name, fn.__module__, fn.__qualname__))
+        return fn
+    return mark

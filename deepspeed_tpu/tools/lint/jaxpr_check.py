@@ -1,0 +1,117 @@
+"""Jaxpr-level checks over the registered hot-path entry points.
+
+Where the AST rules see SOURCE, these checks see what the COMPILER sees:
+
+* **no host callbacks** — the traced program must contain no
+  ``pure_callback`` / ``io_callback`` / ``debug_callback`` / host-transfer
+  primitives; any of those stalls the per-step pipeline on the host link.
+* **donations alias** — an entry point that declares buffer donation must
+  actually get the aliasing (a dtype/layout mismatch silently keeps both
+  copies live and re-opens the OOM the donation was added to close); we
+  assert the lowered module carries ``tf.aliasing_output`` and that
+  compilation emits no "donated buffers were not usable" warning.
+
+Runs under ``JAX_PLATFORMS=cpu`` in tier-1 via ``tests/unit/test_tpu_lint.py``.
+"""
+
+import dataclasses
+import warnings
+from typing import List
+
+import jax
+
+FORBIDDEN_PRIMITIVES = {
+    "pure_callback", "io_callback", "debug_callback", "python_callback",
+    "outside_call", "host_callback", "infeed", "outfeed",
+}
+
+_DONATION_WARNING = "donated buffers were not usable"
+# donation shows up as an input-output pairing fixed at lowering time
+# (tf.aliasing_output) or as a donor XLA pairs during compilation
+# (jax.buffer_donor) — either means the buffer is actually given up
+_ALIAS_ATTRS = ("tf.aliasing_output", "jax.buffer_donor")
+
+
+@dataclasses.dataclass
+class CheckResult:
+    name: str
+    ok: bool
+    problems: List[str]
+
+
+def _walk_primitives(jaxpr, out):
+    for eqn in jaxpr.eqns:
+        out.add(eqn.primitive.name)
+        for v in eqn.params.values():
+            sub = getattr(v, "jaxpr", None)
+            if sub is not None:
+                _walk_primitives(sub, out)
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    sub = getattr(item, "jaxpr", None)
+                    if sub is not None:
+                        _walk_primitives(sub, out)
+
+
+def primitives_of(fn, *args):
+    closed = jax.make_jaxpr(fn)(*args)
+    prims = set()
+    _walk_primitives(closed.jaxpr, prims)
+    return prims
+
+
+def check_entry_point(ep):
+    """Run both checks over one :class:`entry_points.EntryPoint`."""
+    problems = []
+    prims = primitives_of(ep.fn, *ep.args)
+    bad = sorted(prims & FORBIDDEN_PRIMITIVES)
+    if bad:
+        problems.append(f"host callback primitive(s) in traced program: "
+                        f"{', '.join(bad)}")
+    # the unusable-donation warning fires at LOWERING time (compile() is
+    # silent), so both stages run inside the capture
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        lowered = ep.fn.lower(*ep.args)
+        lowered.compile()
+    text = lowered.as_text()
+    if ep.expect_donation and not any(a in text for a in _ALIAS_ATTRS):
+        problems.append("entry point declares no usable buffer donation "
+                        f"(none of {_ALIAS_ATTRS} in lowered module)")
+    min_aliased = getattr(ep, "min_aliased", 0)
+    if min_aliased:
+        # consumed-donation programs: the unusable warning is expected for
+        # the consumed inputs — require the STATE buffers' aliasing count
+        n = sum(text.count(a) for a in _ALIAS_ATTRS)
+        if n < min_aliased:
+            problems.append(f"only {n} donated buffers alias an output "
+                            f"(state requires >= {min_aliased})")
+    else:
+        unusable = [str(w.message) for w in caught
+                    if _DONATION_WARNING in str(w.message)]
+        if unusable:
+            problems.append(f"declared donation does not alias: "
+                            f"{unusable[0]}")
+    return CheckResult(ep.name, not problems, problems)
+
+
+def run_all():
+    from deepspeed_tpu.tools.lint.entry_points import iter_entry_points
+    return [check_entry_point(ep) for ep in iter_entry_points()]
+
+
+def main():
+    results = run_all()
+    ok = True
+    for r in results:
+        status = "OK " if r.ok else "FAIL"
+        print(f"[{status}] {r.name}")
+        for p in r.problems:
+            print(f"       - {p}")
+        ok = ok and r.ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

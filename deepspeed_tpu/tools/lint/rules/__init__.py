@@ -1,0 +1,9 @@
+"""tpu-lint rules — importing this package registers every rule."""
+
+from deepspeed_tpu.tools.lint.rules import (  # noqa: F401
+    tl001_host_transfer,
+    tl002_missing_donation,
+    tl003_jit_side_effects,
+    tl004_bad_static_args,
+    tl005_hot_dict_lookup,
+)

@@ -1,0 +1,65 @@
+"""TL001 — host transfer on a hot path.
+
+``.item()``, ``float()``/``int()``/``bool()`` on computed values,
+``np.asarray``/``np.array``, ``jax.device_get``, ``.block_until_ready()``
+and ``process_allgather`` all force the host to wait for the device and pull
+data over the host link.  Inside a function reachable from a registered hot
+path (``@hot_path``: train step, decode loop, prefill) that stall lands once
+per step and serializes the pipeline XLA would otherwise keep async.
+"""
+
+import ast
+
+from deepspeed_tpu.tools.lint.core import Finding, dotted_name, rule
+
+_SYNC_METHODS = {"item", "block_until_ready"}
+_SYNC_CALLS = {"jax.device_get", "device_get", "np.asarray", "np.array",
+               "numpy.asarray", "numpy.array", "onp.asarray",
+               "multihost_utils.process_allgather", "process_allgather"}
+_CAST_BUILTINS = {"float", "int", "bool"}
+# casts of these are host-side shape/env/config math, never a device sync
+_HOST_ONLY_CALLS = {"len", "np.prod", "math.prod", "os.environ.get",
+                    "os.getenv", "prod"}
+
+
+def _is_computed(node):
+    """Cast args worth flagging: attribute/subscript/call chains — reads of
+    engine state or device results (``float(self._scaler_state.scale)``,
+    ``bool(jax.device_get(x))``).  Bare names are skipped: they are usually
+    host-side API scalars (``int(max_new_tokens)``) and the device-array
+    cases are caught by the explicit sync patterns instead."""
+    if isinstance(node, ast.Call) and \
+            dotted_name(node.func) in _HOST_ONLY_CALLS:
+        return False
+    return isinstance(node, (ast.Attribute, ast.Subscript, ast.Call))
+
+
+@rule("TL001", "host transfer on a hot path")
+def check(module):
+    hot = module.hot_functions()
+    if not hot:
+        return
+    seen = set()
+    for fn in hot:
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            seen.add(id(node))
+            what = None
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS \
+                    and not node.args:
+                what = f".{f.attr}() forces a device->host sync"
+            else:
+                name = dotted_name(f)
+                if name in _SYNC_CALLS:
+                    what = f"{name}(...) pulls device data to the host"
+                elif name in _CAST_BUILTINS and node.args and \
+                        _is_computed(node.args[0]):
+                    what = (f"{name}(...) on a computed value blocks on the "
+                            f"device result")
+            if what:
+                yield Finding(
+                    "TL001", module.path, node.lineno, node.col_offset,
+                    f"{what} inside hot path '{fn.hot_name or fn.name}' — "
+                    f"move it off the per-step path or batch reads")

@@ -1,0 +1,109 @@
+"""TL002 — jit over large buffers without donation.
+
+A ``jax.jit``/``pjit`` whose wrapped function takes a known large-buffer
+parameter (params / opt_state / kv_cache / cache / grads / acc) but declares
+no ``donate_argnums``/``donate_argnames`` holds BOTH the input and output
+copy of that buffer live across the call — at 2.7B params that is the
+difference between fitting and OOM (the round-5 split-prefill fix in git
+history was exactly a missing cache donation).
+
+The rule resolves the wrapped callable when it can: a lambda inline, a local
+``def`` by name, or a method of a class in the same module.
+"""
+
+import ast
+
+from deepspeed_tpu.tools.lint.core import Finding, dotted_name, rule
+
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.experimental.pjit.pjit"}
+LARGE_BUFFER_PARAMS = {"params", "opt_state", "opt_states", "kv_cache",
+                       "cache", "grads", "grad_acc", "acc",
+                       "master_params"}
+_DONATE_KEYS = {"donate_argnums", "donate_argnames"}
+
+
+def _jit_callee(call):
+    """(wrapped_expr, kwargs) if ``call`` is a jit/pjit application."""
+    name = dotted_name(call.func)
+    if name in JIT_NAMES and call.args:
+        return call.args[0], call.keywords
+    # functools.partial(jax.jit, ...) has no positional fn — decorator form
+    return None, None
+
+
+def is_jit_call(call):
+    return dotted_name(call.func) in JIT_NAMES
+
+
+def jit_decorator_kwargs(node):
+    """kwargs of a @jax.jit / @partial(jax.jit, ...) decorator, else None."""
+    for dec in getattr(node, "decorator_list", []):
+        if dotted_name(dec) in JIT_NAMES:
+            return []
+        if isinstance(dec, ast.Call):
+            name = dotted_name(dec.func)
+            if name in JIT_NAMES:
+                return dec.keywords
+            if name in ("functools.partial", "partial") and dec.args and \
+                    dotted_name(dec.args[0]) in JIT_NAMES:
+                return dec.keywords
+    return None
+
+
+def _params_of(expr, module):
+    """Parameter names of the callable expression, or None if unresolvable."""
+    if isinstance(expr, ast.Lambda):
+        a = expr.args
+        return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+    name = None
+    if isinstance(expr, ast.Name):
+        name = expr.id
+    elif isinstance(expr, ast.Attribute):
+        name = expr.attr
+    if name is None:
+        return None
+    for fn in module.functions:
+        if fn.name == name:
+            return fn.params
+    return None
+
+
+def _large(params):
+    return sorted(set(p.lower() for p in params) & LARGE_BUFFER_PARAMS)
+
+
+@rule("TL002", "jit over large buffers without donation")
+def check(module):
+    # call form: jax.jit(f, ...)
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        wrapped, keywords = _jit_callee(node)
+        if wrapped is None:
+            continue
+        if any(kw.arg in _DONATE_KEYS for kw in keywords):
+            continue
+        params = _params_of(wrapped, module)
+        if params is None:
+            continue
+        big = _large(params)
+        if big:
+            yield Finding(
+                "TL002", module.path, node.lineno, node.col_offset,
+                f"jit of function with large-buffer parameter(s) "
+                f"{', '.join(big)} but no donate_argnums — input and output "
+                f"copies stay live together; donate or annotate why not")
+    # decorator form: @jax.jit / @partial(jax.jit, ...)
+    for fn in module.functions:
+        keywords = jit_decorator_kwargs(fn.node)
+        if keywords is None:
+            continue
+        if any(kw.arg in _DONATE_KEYS for kw in keywords):
+            continue
+        big = _large(fn.params)
+        if big:
+            yield Finding(
+                "TL002", module.path, fn.node.lineno, fn.node.col_offset,
+                f"@jit on '{fn.name}' with large-buffer parameter(s) "
+                f"{', '.join(big)} but no donate_argnums — donate or "
+                f"annotate why not")

@@ -1,0 +1,72 @@
+"""TL003 — Python side effects inside a jitted function.
+
+``print``, ``logger.*`` calls, ``open`` and ``global`` writes inside a
+function that is jit-wrapped run at TRACE time only: they fire once per
+compilation, not once per step — a logging call that looks per-step is
+silently dropped after the first call, and any value it prints is a tracer.
+Use ``jax.debug.print``/``jax.debug.callback`` (which are traced) or move
+the effect outside the jitted region.
+"""
+
+import ast
+
+from deepspeed_tpu.tools.lint.core import Finding, dotted_name, rule
+from deepspeed_tpu.tools.lint.rules.tl002_missing_donation import (
+    is_jit_call, jit_decorator_kwargs)
+
+_LOGGER_NAMES = {"logger", "logging", "log"}
+_ALLOWED_DOTTED = {"jax.debug.print", "jax.debug.callback",
+                   "debug.print", "debug.callback"}
+
+
+def _jitted_functions(module):
+    """FunctionInfos that are jit-wrapped: decorator form, or passed by name
+    to a jit call in this module."""
+    out = []
+    jit_arg_names = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) and is_jit_call(node) and node.args:
+            f = node.args[0]
+            if isinstance(f, ast.Name):
+                jit_arg_names.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                jit_arg_names.add(f.attr)
+    for fn in module.functions:
+        if jit_decorator_kwargs(fn.node) is not None or \
+                fn.name in jit_arg_names:
+            out.append(fn)
+    return out
+
+
+@rule("TL003", "Python side effect inside a jitted function")
+def check(module):
+    seen = set()
+    for fn in _jitted_functions(module):
+        for node in ast.walk(fn.node):
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            if isinstance(node, ast.Global):
+                yield Finding(
+                    "TL003", module.path, node.lineno, node.col_offset,
+                    f"'global' write inside jitted '{fn.name}' runs at trace "
+                    f"time only — once per compile, not per step")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in _ALLOWED_DOTTED:
+                continue
+            what = None
+            if name in ("print", "open"):
+                what = f"{name}()"
+            elif isinstance(node.func, ast.Attribute):
+                root = node.func.value
+                if isinstance(root, ast.Name) and root.id in _LOGGER_NAMES:
+                    what = f"{root.id}.{node.func.attr}()"
+            if what:
+                yield Finding(
+                    "TL003", module.path, node.lineno, node.col_offset,
+                    f"{what} inside jitted '{fn.name}' fires at trace time "
+                    f"only (values are tracers) — use jax.debug.print or "
+                    f"move it out of the jitted region")

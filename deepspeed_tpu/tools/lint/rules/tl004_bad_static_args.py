@@ -1,0 +1,133 @@
+"""TL004 — unhashable or array-valued static args.
+
+``static_argnums``/``static_argnames`` hash their values into the
+compilation-cache key.  A list/dict/set there raises at call time; an array
+(or anything freshly constructed per call) silently RECOMPILES on every
+step — the classic "why is every step 30 s" bug.  The rule flags:
+
+* jit-wrapped functions whose static parameters default to mutable literals,
+* call sites of a jitted name passing list/dict/set/array expressions in a
+  static position.
+"""
+
+import ast
+
+from deepspeed_tpu.tools.lint.core import Finding, dotted_name, rule
+from deepspeed_tpu.tools.lint.rules.tl002_missing_donation import (
+    JIT_NAMES, jit_decorator_kwargs)
+
+_ARRAY_CTORS = {"jnp.array", "jnp.asarray", "np.array", "np.asarray",
+                "jnp.zeros", "jnp.ones", "jnp.arange", "np.zeros", "np.ones",
+                "jax.numpy.array", "jax.numpy.asarray"}
+
+
+def _static_spec(keywords):
+    """(argnums, argnames) literal values from jit kwargs, or None."""
+    nums, names = None, None
+    for kw in keywords or []:
+        if kw.arg == "static_argnums":
+            nums = _int_tuple(kw.value)
+        elif kw.arg == "static_argnames":
+            names = _str_tuple(kw.value)
+    if nums is None and names is None:
+        return None
+    return nums or (), names or ()
+
+
+def _int_tuple(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _str_tuple(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+def _bad_value(node):
+    """Why this expression must not be a static arg, or None."""
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return "unhashable (list/dict/set)"
+    if isinstance(node, ast.Call) and dotted_name(node.func) in _ARRAY_CTORS:
+        return "an array (hashes by identity -> recompiles every call)"
+    return None
+
+
+@rule("TL004", "unhashable or array-valued static args")
+def check(module):
+    # (1) defaults of static params on @jit-decorated functions
+    for fn in module.functions:
+        keywords = jit_decorator_kwargs(fn.node)
+        spec = _static_spec(keywords)
+        if spec is None:
+            continue
+        nums, names = spec
+        a = fn.node.args
+        defaults = list(a.defaults)
+        # align defaults with trailing positional params; indices count the
+        # FULL signature (including self/cls) — that is what jax's
+        # static_argnums refers to
+        pos = [p.arg for p in (*a.posonlyargs, *a.args)]
+        for i, d in enumerate(defaults):
+            pname = pos[len(pos) - len(defaults) + i]
+            idx = pos.index(pname)
+            if (idx in nums or pname in names):
+                why = _bad_value(d)
+                if why:
+                    yield Finding(
+                        "TL004", module.path, d.lineno, d.col_offset,
+                        f"static arg '{pname}' of jitted '{fn.name}' "
+                        f"defaults to {why}")
+    # (2) call sites of names bound to jit(..., static_argnums=...)
+    static_of = {}          # bound name -> (nums, names)
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if isinstance(v, ast.Call) and dotted_name(v.func) in JIT_NAMES:
+            spec = _static_spec(v.keywords)
+            if spec is not None:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        static_of[tgt.id] = spec
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = node.func
+        spec = None
+        if isinstance(callee, ast.Name) and callee.id in static_of:
+            spec = static_of[callee.id]
+        elif isinstance(callee, ast.Call) and \
+                dotted_name(callee.func) in JIT_NAMES:
+            spec = _static_spec(callee.keywords)   # jax.jit(f, ...)(args)
+        if spec is None:
+            continue
+        nums, names = spec
+        for i, arg in enumerate(node.args):
+            if i in nums:
+                why = _bad_value(arg)
+                if why:
+                    yield Finding(
+                        "TL004", module.path, arg.lineno, arg.col_offset,
+                        f"static arg {i} is {why}")
+        for kw in node.keywords:
+            if kw.arg in names:
+                why = _bad_value(kw.value)
+                if why:
+                    yield Finding(
+                        "TL004", module.path, kw.value.lineno,
+                        kw.value.col_offset,
+                        f"static arg '{kw.arg}' is {why}")

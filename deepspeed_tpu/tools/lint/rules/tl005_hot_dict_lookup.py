@@ -1,0 +1,54 @@
+"""TL005 — per-step config/dict lookups on a hot path.
+
+``config["..."]``/``cfg.get("...")`` inside a hot function re-does a string
+hash + dict probe (and defeats any caching keyed on the extracted value)
+once per step.  Hoist the read to setup time and close over the value; XLA
+then bakes it into the compiled program.
+"""
+
+import ast
+
+from deepspeed_tpu.tools.lint.core import Finding, dotted_name, rule
+
+_CONFIG_TOKENS = ("config", "cfg", "settings", "hparams")
+
+
+def _is_config_name(node):
+    name = dotted_name(node)
+    if not name:
+        return False
+    last = name.split(".")[-1].lower()
+    return any(tok in last for tok in _CONFIG_TOKENS)
+
+
+@rule("TL005", "per-step config lookup on a hot path")
+def check(module):
+    hot = module.hot_functions()
+    if not hot:
+        return
+    seen = set()
+    for fn in hot:
+        for node in ast.walk(fn.node):
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            target = None
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.slice, ast.Constant) and \
+                    isinstance(node.slice.value, str) and \
+                    _is_config_name(node.value):
+                target = f'{dotted_name(node.value)}["{node.slice.value}"]'
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "get" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str) and \
+                    _is_config_name(node.func.value):
+                target = (f'{dotted_name(node.func.value)}'
+                          f'.get("{node.args[0].value}")')
+            if target:
+                yield Finding(
+                    "TL005", module.path, node.lineno, node.col_offset,
+                    f"{target} inside hot path '{fn.hot_name or fn.name}' — "
+                    f"hoist the lookup to setup time and close over the "
+                    f"value")

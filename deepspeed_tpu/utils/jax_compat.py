@@ -1,0 +1,96 @@
+"""Version shims for JAX APIs that moved or were renamed between releases.
+
+The runtime targets the newest JAX surface (``jax.shard_map`` with
+``check_vma``/``axis_names``; ``pltpu.CompilerParams``) but must also run on
+0.4.x, where the same features live at ``jax.experimental.shard_map.shard_map``
+(kwargs ``check_rep``/``auto``) and ``pltpu.TPUCompilerParams``.  Import from
+here instead of probing ``jax`` at each call site.
+"""
+
+import functools
+
+import jax
+
+try:  # jax >= 0.6: top-level export
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+    _NEW_SHARD_MAP = True
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _NEW_SHARD_MAP = False
+
+try:
+    from jax.experimental.pallas import tpu as _pltpu
+    # Renamed TPUCompilerParams -> CompilerParams in newer releases.
+    CompilerParams = getattr(_pltpu, "CompilerParams", None) or \
+        _pltpu.TPUCompilerParams
+except ImportError:  # pallas absent (minimal CPU builds)
+    CompilerParams = None
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` (new JAX) or a psum-of-ones fallback (0.4.x)."""
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def get_opaque_trace_state():
+    """``jax.core.get_opaque_trace_state``; 0.4.x requires a ``convention``
+    argument it then ignores."""
+    from jax import core
+    try:
+        return core.get_opaque_trace_state()
+    except TypeError:
+        return core.get_opaque_trace_state(convention="nnx")
+
+
+def process_allgather_stacked(x):
+    """``multihost_utils.process_allgather`` with a guaranteed leading
+    process axis — the 0.4.x single-process fast path returns the input
+    unstacked, so a reduce over axis 0 would silently reduce the data."""
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    g = multihost_utils.process_allgather(x)
+    if jax.process_count() == 1 and jnp.shape(g) == jnp.shape(x):
+        g = jnp.asarray(g)[None]
+    return g
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=None,
+              axis_names=None, **kw):
+    """``jax.shard_map`` with new-style kwargs on any supported JAX.
+
+    ``check_vma`` maps to 0.4.x ``check_rep``; ``axis_names`` (the manual
+    axes) maps to its complement ``auto``.  Usable directly or as a
+    ``functools.partial`` decorator target, like the real thing.
+    """
+    if f is None:
+        return functools.partial(shard_map, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=check_vma,
+                                 axis_names=axis_names, **kw)
+    if _NEW_SHARD_MAP:
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        if axis_names is not None:
+            kw["axis_names"] = frozenset(axis_names)
+    else:
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        # axis_names is dropped: 0.4.x partial-auto (``auto=`` complement)
+        # lowers to a PartitionId op XLA:CPU rejects, and fully-manual is
+        # SEMANTICALLY equivalent when the body only names the manual axes.
+        # It is not partitioning-equivalent: unmentioned axes replicate the
+        # body's compute instead of staying auto-sharded — warn so a
+        # multi-axis production mesh doesn't silently pay that.
+        if axis_names is not None:
+            extra = set(mesh.axis_names) - set(axis_names)
+            if any(mesh.shape[a] > 1 for a in extra):
+                import warnings
+                warnings.warn(
+                    f"jax {jax.__version__} shard_map has no axis_names: "
+                    f"axes {sorted(a for a in extra if mesh.shape[a] > 1)} "
+                    f"run fully-manual (body replicated over them) instead "
+                    f"of auto-partitioned", stacklevel=2)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kw)

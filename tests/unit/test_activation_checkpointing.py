@@ -47,7 +47,9 @@ def test_checkpoint_matches_plain_grads():
     plain = jax.grad(lambda p: _mlp(p, x))(params)
     ckpt = jax.grad(lambda p: checkpointing.checkpoint(_mlp, p, x))(params)
     for k in plain:
-        np.testing.assert_allclose(plain[k], ckpt[k], rtol=1e-6)
+        # remat replays the forward under a different fusion schedule, so
+        # grads match to float32 accumulation order, not bitwise
+        np.testing.assert_allclose(plain[k], ckpt[k], rtol=1e-4, atol=1e-6)
 
 
 def test_checkpoint_inside_jit():
@@ -69,7 +71,7 @@ def test_cpu_checkpointing_policy_still_correct():
     x = jnp.ones((2, 16), jnp.float32)
     plain = jax.grad(lambda p: _mlp(p, x))(params)
     ckpt = jax.grad(lambda p: checkpointing.checkpoint(_mlp, p, x))(params)
-    np.testing.assert_allclose(plain["w2"], ckpt["w2"], rtol=1e-6)
+    np.testing.assert_allclose(plain["w2"], ckpt["w2"], rtol=1e-4, atol=1e-6)
 
 
 def test_checkpoint_wrapper():

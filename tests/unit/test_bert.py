@@ -75,7 +75,7 @@ def test_bert_mlm_trains_through_engine():
     ids[rng.random(ids.shape) < 0.3] = MASK
     batch = {"input_ids": ids, "labels": labels}
     losses = []
-    for _ in range(12):
+    for _ in range(16):
         loss = engine(batch)
         engine.backward(loss)
         engine.step()

@@ -7,7 +7,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 import deepspeed_tpu.comm as dist
 from deepspeed_tpu.parallel.topology import (initialize_topology, DP_AXES,

@@ -25,9 +25,11 @@ def base_config(**over):
 
 
 def train_steps(engine, steps=5, seed=0):
+    # one fixed batch: training must memorize it, so the loss decrease is
+    # deterministic (fresh noise every step makes the assert a coin flip)
     losses = []
     for i in range(steps):
-        batch = random_batch(batch_size=16, seed=seed + i)
+        batch = random_batch(batch_size=16, seed=seed)
         loss = engine(batch)
         engine.backward(loss)
         engine.step()

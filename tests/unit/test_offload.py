@@ -142,9 +142,11 @@ def _offload_config(device, nvme_path=None):
 
 
 def _train(engine, steps, seed=0):
+    # fixed batch, as in test_engine: memorization makes the loss-decrease
+    # assertion deterministic
     losses = []
     for i in range(steps):
-        batch = random_batch(batch_size=16, seed=seed + i)
+        batch = random_batch(batch_size=16, seed=seed)
         loss = engine(batch)
         engine.backward(loss)
         engine.step()

@@ -41,7 +41,9 @@ def test_onebit_optimizers_train(opt_type):
                 "optimizer": {"type": opt_type, "params": params}})
     losses = []
     for i in range(12):
-        loss = engine(random_batch(batch_size=16, seed=i))
+        # fixed batch: the compressed-regime assertion needs a deterministic
+        # decreasing trajectory, not fresh noise per step
+        loss = engine(random_batch(batch_size=16, seed=0))
         engine.backward(loss)
         engine.step()
         losses.append(float(jax.device_get(loss)))
@@ -137,7 +139,7 @@ def test_compressed_allreduce_unbiased_over_workers(eight_devices):
     """With different per-worker tensors (sharded batch axis), the decoded
     mean must correlate strongly with the true mean."""
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from jax import shard_map
+    from deepspeed_tpu.utils.jax_compat import shard_map
     import functools
     mesh = Mesh(np.array(eight_devices), ("dp",))
     rng = np.random.default_rng(1)

@@ -1,0 +1,21 @@
+"""Suppression fixture: line-level, function-level, and a non-matching rule
+id that must NOT suppress."""
+import jax
+from deepspeed_tpu.tools.lint.hotpath import hot_path
+
+
+@hot_path("fixture.step")
+def step_with_line_suppression(loss):
+    return loss.item()  # tpu-lint: disable=TL001 -- read once per epoch for logging
+
+
+@hot_path("fixture.step2")
+def step_with_function_suppression(loss):  # tpu-lint: disable=TL001 -- whole function is a host-side drain
+    a = loss.item()
+    b = jax.device_get(loss)
+    return a, b
+
+
+@hot_path("fixture.step3")
+def step_with_wrong_rule(loss):
+    return loss.item()  # tpu-lint: disable=TL002 -- wrong id, TL001 still fires

@@ -1,0 +1,21 @@
+"""TL001 negative fixture: the same syncs OFF the hot path, and benign
+host-side casts ON it."""
+import numpy as np
+import jax
+from deepspeed_tpu.tools.lint.hotpath import hot_path
+
+
+def eval_epoch(losses):
+    # not a hot path: syncing here is fine
+    return [float(jax.device_get(l)) for l in losses]
+
+
+@hot_path("fixture.train_step")
+def train_step(params, batch, max_steps=8):
+    steps = int(max_steps)           # bare-name cast: host API scalar
+    n = int(np.prod((4, 8)))         # shape math, whitelisted
+    return params, steps, n
+
+
+def cold_helper(x):
+    return x.item()                  # unreachable from any hot path

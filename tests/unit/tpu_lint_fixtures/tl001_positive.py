@@ -1,0 +1,28 @@
+"""TL001 positive fixture: host syncs inside a hot path."""
+import numpy as np
+import jax
+from deepspeed_tpu.tools.lint.hotpath import hot_path
+
+
+@hot_path("fixture.train_step")
+def train_step(params, batch):
+    loss = compute_loss(params, batch)
+    metric = loss.item()                      # TL001
+    host = np.asarray(loss)                   # TL001
+    pulled = jax.device_get(loss)             # TL001
+    loss.block_until_ready()                  # TL001
+    scale = float(params["scale"])            # TL001 (computed cast)
+    return metric, host, pulled, scale
+
+
+def helper_called_from_hot(x):
+    return float(jax.device_get(x))           # TL001 x2 (reachable)
+
+
+@hot_path("fixture.decode")
+def decode(tokens):
+    return helper_called_from_hot(tokens)
+
+
+def compute_loss(params, batch):
+    return batch

@@ -1,0 +1,18 @@
+"""TL002 negative fixture: donated, or no large buffers."""
+import jax
+import functools
+
+
+def apply_update(params, opt_state, grads):
+    return params, opt_state
+
+
+update_fn = jax.jit(apply_update, donate_argnums=(0, 1, 2))
+
+
+@functools.partial(jax.jit, donate_argnames=("kv_cache",))
+def prefill(params, kv_cache, chunk):
+    return kv_cache
+
+
+small_fn = jax.jit(lambda x, y: x + y)       # no large-buffer params

@@ -1,0 +1,20 @@
+"""TL002 positive fixture: jit over large buffers, no donation."""
+import jax
+import functools
+
+
+def apply_update(params, opt_state, grads):
+    return params, opt_state
+
+
+update_fn = jax.jit(apply_update)                       # TL002
+
+
+@jax.jit                                                # TL002
+def fused_step(params, opt_state, batch):
+    return params, opt_state
+
+
+@functools.partial(jax.jit, static_argnums=(2,))        # TL002
+def prefill(params, kv_cache, chunk):
+    return kv_cache

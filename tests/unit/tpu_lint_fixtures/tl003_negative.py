@@ -1,0 +1,14 @@
+"""TL003 negative fixture: traced debugging and effects outside jit."""
+import jax
+
+
+@jax.jit
+def step(x):
+    jax.debug.print("stepping {}", x)    # traced — allowed
+    return x * 2
+
+
+def driver(x):
+    out = step(x)
+    print("done", out)                   # outside jit — fine
+    return out

@@ -1,0 +1,21 @@
+"""TL003 positive fixture: Python side effects inside jitted functions."""
+import jax
+from deepspeed_tpu.utils.logging import logger
+
+_count = 0
+
+
+@jax.jit
+def step(x):
+    global _count                        # TL003
+    print("stepping", x)                 # TL003
+    logger.info("traced value %s", x)    # TL003
+    return x * 2
+
+
+def loss_fn(x):
+    print("loss", x)                     # TL003 (jit-wrapped below)
+    return x
+
+
+loss_jit = jax.jit(loss_fn)

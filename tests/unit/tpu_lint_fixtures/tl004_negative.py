@@ -1,0 +1,14 @@
+"""TL004 negative fixture: hashable static args."""
+import jax
+import jax.numpy as jnp
+
+
+def run(shape, x):
+    return x.reshape(shape)
+
+
+run_jit = jax.jit(run, static_argnums=(0,))
+out = run_jit((4, 4), jnp.ones(16))          # tuple: hashable, stable
+
+no_static = jax.jit(run)
+no_static_out = no_static([4, 4], jnp.ones(16))   # not a static position

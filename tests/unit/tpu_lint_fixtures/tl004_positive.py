@@ -1,0 +1,24 @@
+"""TL004 positive fixture: unhashable / array-valued static args."""
+import jax
+import jax.numpy as jnp
+
+
+def run(shape, x):
+    return x.reshape(shape)
+
+
+run_jit = jax.jit(run, static_argnums=(0,))
+out = run_jit([4, 4], jnp.ones(16))                    # TL004: list static
+
+
+@jax.jit
+def _inline(x):
+    return x
+
+
+def scale(factors, x):
+    return x
+
+
+scale_jit = jax.jit(scale, static_argnames=("factors",))
+out2 = scale_jit(factors=jnp.array([1.0, 2.0]), x=jnp.ones(2))   # TL004: array static

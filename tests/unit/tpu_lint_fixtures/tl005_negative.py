@@ -1,0 +1,16 @@
+"""TL005 negative fixture: lookups hoisted to setup, or off the hot path."""
+from deepspeed_tpu.tools.lint.hotpath import hot_path
+
+
+def make_train_step(config):
+    lr = config["lr"]                        # setup time — fine
+
+    @hot_path("fixture.train_step")
+    def train_step(params, batch):
+        return params, lr                    # closed-over value
+
+    return train_step
+
+
+def build(config):
+    return config.get("optimizer")           # cold path — fine

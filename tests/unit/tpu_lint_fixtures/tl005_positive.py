@@ -1,0 +1,9 @@
+"""TL005 positive fixture: per-step config lookups on a hot path."""
+from deepspeed_tpu.tools.lint.hotpath import hot_path
+
+
+@hot_path("fixture.train_step")
+def train_step(params, batch, config):
+    lr = config["lr"]                        # TL005
+    clip = config.get("gradient_clipping")   # TL005
+    return params, lr, clip

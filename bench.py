@@ -23,16 +23,31 @@ bandwidth and MXU flops so every roofline/MFU claim is anchored to an
 in-run measurement, not just a datasheet constant.
 
 Crash containment (the round-3 lesson: one late-phase OOM erased the whole
-record): each phase runs in its OWN subprocess, like the reference runs
+record; the round-5 lesson: one 40-min cold compile starved everything
+behind it): each phase runs in its OWN subprocess, like the reference runs
 each workload under its launcher (``launcher/runner.py:377``).  The parent
-never imports jax, so a dead phase cannot pin device memory anywhere;
-results accumulate into ``.bench_partial.json`` as phases complete; a
-failed phase is retried ONCE with a safe config (remat on / smaller batch,
-recorded as ``"fallback": true``) and a double failure records an
-``error`` field instead of killing the run.  The final line on stdout is
-ONE JSON object and the exit code is 0 whenever the harness itself
-survived — missing numbers are visible as ``error`` fields, never as a
-stack trace in place of the record.
+never imports jax, so a dead phase cannot pin device memory anywhere.
+Phases run CHEAP-FIRST under per-phase wall-clock budgets
+(``BENCH_PHASE_TIMEOUT`` × ``PHASE_TIMEOUT_SCALE``); an overrun is
+skipped-and-recorded (no fallback retry — a safe config fixes an OOM, not
+slowness; ``BENCH_RETRY_ON_TIMEOUT=1`` re-enables it), and an optional
+``BENCH_SUITE_BUDGET`` skips whatever the total budget can no longer
+afford.  A crashed phase is retried ONCE with a safe config (remat on /
+smaller batch, recorded as ``"fallback": true``) and a double failure
+records an ``error`` field instead of killing the run.  Results accumulate
+TWO ways as phases complete: the raw phase map in ``.bench_partial.json``
+and the full driver-contract record in ``BENCH_partial.json`` (env
+``BENCH_RESULTS_JSON``), so an interrupt / kill / crash after phase k
+still leaves a complete record of all k finished phases — Ctrl-C and
+SIGTERM additionally flush that record to stdout and exit 0.  Engines run
+with the persistent compile/executable cache
+(``runtime/compile_cache.py``, dir ``.jax_bench_cache``), so every
+program — including sft_2.7b's — is cold exactly once per machine; each
+phase's record carries a ``compile_cache`` block showing what it compiled
+vs reloaded.  The final line on stdout is ONE JSON object and the exit
+code is 0 whenever the harness itself survived — missing numbers are
+visible as ``error`` fields, never as a stack trace in place of the
+record.
 
 ``BENCH_MODEL``/``BENCH_*`` env vars run a single custom training bench
 in-process instead (old behavior).
@@ -50,15 +65,42 @@ sys.path.insert(0, REPO)
 import numpy as np
 
 
+def _cache_dir():
+    return os.environ.get("DSTPU_COMPILE_CACHE_DIR") \
+        or os.path.join(REPO, ".jax_bench_cache")
+
+
 def _setup_compile_cache():
-    """Persistent XLA compile cache: the suite is compile-dominated through
-    the tunneled remote-compile service (~100 s per unrolled decode
-    program); warm reruns cut wall time by well over half.  Shared by all
-    phase subprocesses."""
-    import jax
-    cache = os.path.join(REPO, ".jax_bench_cache")
-    jax.config.update("jax_compilation_cache_dir", cache)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    """Persistent compile/executable cache (runtime/compile_cache.py): the
+    suite is compile-dominated (sft_2.7b's four 2.7B backward programs
+    alone approach 40 min cold — the rc=124 that erased the round-5
+    record); the framework cache makes every program cold exactly once per
+    machine.  Shared by all phase subprocesses."""
+    from deepspeed_tpu.runtime.compile_cache import configure_persistent_cache
+    configure_persistent_cache(_cache_dir(), min_compile_time_secs=2.0)
+
+
+def _cc_block():
+    """``compile_cache`` config block handed to every engine a phase
+    builds: persistent XLA cache + serialized AOT executables, shared
+    across phase subprocesses and across runs."""
+    return {"enabled": True, "cache_dir": _cache_dir(),
+            "min_compile_time_secs": 2.0}
+
+
+def _cache_report(before):
+    """Delta of the compile-cache counters across one phase body — makes
+    compile cost (and the warm-run savings) visible in the record."""
+    from deepspeed_tpu.runtime.compile_cache import stats
+    now = stats().snapshot()
+    rep = {k: now[k] - before.get(k, 0)
+           for k in ("persistent_requests", "persistent_hits",
+                     "executable_hits", "executable_misses",
+                     "executable_saves")}
+    rep["compile_seconds"] = {
+        k: round(v, 1) for k, v in now["compile_seconds"].items()
+        if k not in before.get("compile_seconds", {})}
+    return rep
 
 
 def _sync_scalar(x):
@@ -117,8 +159,13 @@ def calibrate_bench():
         # min-of-diffs is biased FAST (a contended t1 shrinks the diff and
         # inflates the rate — an early round recorded 3.8x the datasheet
         # bandwidth that way), while the median rejects both tails.
+        # sample until 5 positive pairs land (cap 12 attempts): on a
+        # loaded 1-core CI box a burst of scheduler noise can flip several
+        # consecutive diffs negative, and giving up after 5 straight
+        # attempts made the whole phase flaky — the estimator is unchanged
+        # (median of positive diffs), only the patience grew
         diffs = []
-        for _ in range(5):
+        for _ in range(12):
             t0 = time.perf_counter()
             _sync_scalar(fn(warm_arg, reps))
             t1 = time.perf_counter()
@@ -127,6 +174,8 @@ def calibrate_bench():
             d = (t2 - t1) - (t1 - t0)
             if d > 0:
                 diffs.append(d)
+            if len(diffs) >= 5:
+                break
         if not diffs:
             raise RuntimeError(
                 "calibration: dispatch jitter swamped the measurement "
@@ -227,6 +276,7 @@ def train_bench(model_name, *, micro_bs, zero_stage, steps, seq=2048,
         "bf16": {"enabled": True, "master_weights_in_bf16": bool(lean)},
         "zero_optimization": {"stage": zero_stage},
         "gradient_clipping": 1.0,
+        "compile_cache": _cc_block(),
     }
     if offload:
         config["zero_optimization"]["offload_optimizer"] = {
@@ -315,7 +365,7 @@ def decode_bench(model_name="opt-1.3b", *, batch_size=16, prompt=256,
     model = Transformer(cfg)
     quant = {"enabled": True, "bits": 8, "per_channel": True} if int8 else {}
     eng = InferenceEngine(model, DeepSpeedInferenceConfig(
-        dtype="bfloat16", quant=quant))
+        dtype="bfloat16", quant=quant, compile_cache=_cc_block()))
     eng.init_params()
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch_size, prompt)).astype(np.int32)
@@ -459,6 +509,7 @@ def hybrid_bench(model_name="opt-1.3b", *, train_bs=2, rollout_bs=(8, 32, 64),
             # :178 generate; quantized view is this framework's extension)
             "hybrid_engine": {"enabled": True,
                               "quantize_rollouts": bool(quantize_rollouts)},
+            "compile_cache": _cc_block(),
         })
     rng = np.random.default_rng(0)
     batch = {"input_ids": rng.integers(
@@ -678,12 +729,17 @@ def _sft27(fallback):
 
 
 PHASES = [
-    # (key in result, phase name, runner(fallback) -> dict)
+    # (key in result, phase name, runner(fallback) -> dict).  Ordered
+    # cheap-first (the round-5 lesson: the most expensive phase ran 4th
+    # and its 40-min cold compile starved the ten phases behind it): a
+    # budget overrun late in the suite can only cost the phases BEHIND
+    # it, and the record already holds everything cheap.  sft_2.7b — the
+    # compile-dominated single-chip 2.7B story — runs dead last, and with
+    # the persistent compile cache its cold compile happens exactly once
+    # per machine.
     ("calibration", "calibrate", lambda fb: calibrate_bench()),
-    ("__headline__", "north", _north),
     ("sft_350m_guard", "guard", _guard),
-    # single-chip large-model story: 2.7B via ZeRO-Offload (see _sft27)
-    ("sft_2.7b", "sft_2.7b", _sft27),
+    ("__headline__", "north", _north),
     # the offload/NVMe tier, measured against the same in-HBM workload
     ("optimizer_offload", "offload",
      lambda fb: offload_bench(gas=2 if fb else 4,
@@ -725,7 +781,19 @@ PHASES = [
                              quantize_rollouts=not fb)),
     ("long_context", "long_context",
      lambda fb: long_context_bench("opt-1.3b", seq=4096 if fb else 8192)),
+    # single-chip large-model story: 2.7B via ZeRO-Offload (see _sft27) —
+    # LAST: the most compile- and wall-clock-expensive phase must never
+    # again starve the record (round-5 rc=124)
+    ("sft_2.7b", "sft_2.7b", _sft27),
 ]
+
+# per-phase wall-clock budget, as a multiple of BENCH_PHASE_TIMEOUT: the
+# compile-heavy tails get more rope without inflating every phase's budget
+PHASE_TIMEOUT_SCALE = {
+    "sft_2.7b": 2.0,
+    "long_context": 1.5,
+    "hybrid": 1.5,
+}
 
 
 def run_phase(name, fallback, out_path):
@@ -737,20 +805,27 @@ def run_phase(name, fallback, out_path):
         import jax
         jax.config.update("jax_platforms", "cpu")
     # crash-containment test knobs (tests/unit/test_bench_harness.py): die
-    # on the primary attempt (the fallback retry must recover) or on every
-    # attempt (the parent must record the error and keep going)
+    # on the primary attempt (the fallback retry must recover), die on
+    # every attempt (the parent must record the error and keep going), or
+    # hang (the parent's per-phase budget must skip-and-record)
     if os.environ.get("BENCH_TEST_FAIL_PRIMARY") == name and not fallback:
         raise RuntimeError("injected primary-attempt failure")
     if os.environ.get("BENCH_TEST_FAIL_ALWAYS") == name:
         raise RuntimeError("injected unconditional failure")
+    if os.environ.get("BENCH_TEST_HANG") == name:
+        time.sleep(10 ** 6)
     _setup_compile_cache()
     runner = next((r for _, n, r in PHASES if n == name), None)
     if runner is None:
         raise SystemExit(f"unknown phase {name!r}; valid: "
                          f"{', '.join(n for _, n, _ in PHASES)}")
+    from deepspeed_tpu.runtime.compile_cache import stats
+    before = stats().snapshot()
     result = runner(fallback)
     if fallback:
         result["fallback"] = True
+    # compile cost observability: how much this phase compiled vs reloaded
+    result["compile_cache"] = _cache_report(before)
     with open(out_path, "w") as f:
         json.dump(result, f)
 
@@ -809,66 +884,10 @@ def _spawn_phase(name, fallback, timeout_s, extra_env):
     return None, f"{reason}; log tail: {tail}", wall
 
 
-def main():
-    if os.environ.get("BENCH_MODEL"):
-        _setup_compile_cache()
-        custom_single_bench()
-        return
-
-    # 3000s: the sft_2.7b phase traces + compiles four 2.7B backward
-    # programs; with a cold compile cache that alone approaches 40 min —
-    # the persistent cache (.jax_bench_cache) makes warm reruns fit easily
-    timeout_s = int(os.environ.get("BENCH_PHASE_TIMEOUT", "3000"))
-    partial_path = os.path.join(_out_dir(), ".bench_partial.json")
-    result = {}
-    errors = {}
-    extra_env = {}
-
-    phases = PHASES
-    if os.environ.get("BENCH_PHASES"):      # subset, for debugging/tests
-        want = set(os.environ["BENCH_PHASES"].split(","))
-        phases = [p for p in PHASES if p[1] in want]
-
-    for key, name, _ in phases:
-        phase, err, wall = _spawn_phase(name, False, timeout_s, extra_env)
-        if phase is None:
-            print(f"bench: phase {name} failed ({err.splitlines()[0] if err else '?'}); "
-                  f"retrying with safe config", file=sys.stderr)
-            phase, err2, wall = _spawn_phase(name, True, timeout_s, extra_env)
-            # both attempts' errors matter: the fallback can fail for a
-            # DIFFERENT reason than the primary (config bug, timeout)
-            err = None if phase is not None else \
-                f"primary attempt: {err}\nfallback attempt: {err2}"
-        if phase is None:
-            errors[name] = err
-            phase = {"error": err}
-            print(f"bench: phase {name} failed twice — recording the error "
-                  f"and continuing", file=sys.stderr)
-        phase["phase_wall_s"] = round(wall, 1)
-        if key == "calibration" and "measured_mxu_tflops" in phase:
-            # anchor later phases' roofline math to the measured peaks —
-            # but ONLY when they are physically plausible: tunnel jitter
-            # can corrupt the differenced timing (a >datasheet "measured
-            # peak" would silently deflate every *_vs_measured below it)
-            plausible = (0.3 <= phase.get("mxu_fraction_of_datasheet", 0)
-                         <= 1.1
-                         and 0.3 <= phase.get("hbm_fraction_of_datasheet", 0)
-                         <= 1.1)
-            if plausible:
-                extra_env["BENCH_MEASURED_TFLOPS"] = \
-                    str(phase["measured_mxu_tflops"])
-                extra_env["BENCH_MEASURED_GBPS"] = \
-                    str(phase["measured_hbm_gbps"])
-            else:
-                phase["calibration_unreliable"] = True
-                print("bench: calibration outside plausible range — "
-                      "later phases use datasheet peaks only",
-                      file=sys.stderr)
-        result[key] = phase
-        with open(partial_path, "w") as f:     # incremental record
-            json.dump(result, f, indent=1)
-        print(f"bench: phase {name} done in {wall:.0f}s", file=sys.stderr)
-
+def _assemble_final(result, errors):
+    """The final driver-contract record, from whatever phases are done —
+    callable after EVERY phase (incremental record) and at exit."""
+    result = dict(result)
     north = result.pop("__headline__", {})
     calib = result.get("calibration", {})
     platform = calib.get("platform", "unknown")
@@ -895,6 +914,134 @@ def main():
     }
     if errors:
         final["phase_errors"] = errors
+    return final
+
+
+def _write_record(path, record):
+    """Atomic write: a reader (or a crash) never sees a half-written
+    record."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1)
+    os.replace(tmp, path)
+
+
+def main():
+    if os.environ.get("BENCH_MODEL"):
+        _setup_compile_cache()
+        custom_single_bench()
+        return
+
+    # 3000s: the sft_2.7b phase traces + compiles four 2.7B backward
+    # programs; with a cold compile cache that alone approaches 40 min —
+    # the persistent cache (.jax_bench_cache) makes warm reruns fit easily
+    # (and PHASE_TIMEOUT_SCALE gives the compile-heavy tail phases more)
+    timeout_s = int(os.environ.get("BENCH_PHASE_TIMEOUT", "3000"))
+    # total-suite budget (seconds; 0 = off): once exhausted, remaining
+    # phases are recorded as skipped instead of starving whatever driver
+    # is wrapping this run in ITS OWN timeout (the round-5 rc=124)
+    suite_budget = float(os.environ.get("BENCH_SUITE_BUDGET", "0"))
+    partial_path = os.path.join(_out_dir(), ".bench_partial.json")
+    # final-format record, rewritten after EVERY phase: an interrupt, a
+    # crash, or an external kill after phase k still leaves a complete
+    # record of all k finished phases on disk
+    results_path = os.environ.get("BENCH_RESULTS_JSON") \
+        or os.path.join(_out_dir(), "BENCH_partial.json")
+    result = {}
+    errors = {}
+    extra_env = {}
+    suite_t0 = time.perf_counter()
+
+    phases = PHASES
+    if os.environ.get("BENCH_PHASES"):      # subset, for debugging/tests
+        want = set(os.environ["BENCH_PHASES"].split(","))
+        phases = [p for p in PHASES if p[1] in want]
+
+    # SIGTERM (a wrapping driver's kill) lands like Ctrl-C: emit the
+    # partial record instead of dying with whatever was buffered
+    import signal
+
+    def _sigterm(signum, frame):
+        raise KeyboardInterrupt
+    try:
+        signal.signal(signal.SIGTERM, _sigterm)
+    except ValueError:
+        pass                               # non-main thread (tests)
+
+    interrupted = None
+    name = "startup"
+    try:
+        for key, name, _ in phases:
+            if suite_budget and time.perf_counter() - suite_t0 > suite_budget:
+                result[key] = {"skipped": f"suite budget ({suite_budget:.0f}s) "
+                                          f"exhausted"}
+                print(f"bench: suite budget exhausted — skipping {name}",
+                      file=sys.stderr)
+                continue
+            budget = int(timeout_s * PHASE_TIMEOUT_SCALE.get(name, 1.0))
+            phase, err, wall = _spawn_phase(name, False, budget, extra_env)
+            timed_out = phase is None and err and err.startswith("timeout")
+            if phase is None and timed_out \
+                    and os.environ.get("BENCH_RETRY_ON_TIMEOUT") != "1":
+                # budget overrun: SKIP AND RECORD — a fallback retry after
+                # a timeout doubles the damage to every phase behind it
+                # (crashes still get the fallback retry below: a safe
+                # config fixes an OOM, it does not fix slowness)
+                errors[name] = err
+                phase = {"error": err, "timeout": True}
+                print(f"bench: phase {name} exceeded its {budget}s budget — "
+                      f"recording the overrun and continuing",
+                      file=sys.stderr)
+            elif phase is None:
+                print(f"bench: phase {name} failed "
+                      f"({err.splitlines()[0] if err else '?'}); "
+                      f"retrying with safe config", file=sys.stderr)
+                phase, err2, wall = _spawn_phase(name, True, budget,
+                                                 extra_env)
+                # both attempts' errors matter: the fallback can fail for a
+                # DIFFERENT reason than the primary (config bug, timeout)
+                err = None if phase is not None else \
+                    f"primary attempt: {err}\nfallback attempt: {err2}"
+                if phase is None:
+                    errors[name] = err
+                    phase = {"error": err}
+                    print(f"bench: phase {name} failed twice — recording "
+                          f"the error and continuing", file=sys.stderr)
+            phase["phase_wall_s"] = round(wall, 1)
+            if key == "calibration" and "measured_mxu_tflops" in phase:
+                # anchor later phases' roofline math to the measured peaks —
+                # but ONLY when they are physically plausible: tunnel jitter
+                # can corrupt the differenced timing (a >datasheet "measured
+                # peak" would silently deflate every *_vs_measured below it)
+                plausible = (0.3 <= phase.get("mxu_fraction_of_datasheet", 0)
+                             <= 1.1
+                             and 0.3 <= phase.get("hbm_fraction_of_datasheet",
+                                                  0) <= 1.1)
+                if plausible:
+                    extra_env["BENCH_MEASURED_TFLOPS"] = \
+                        str(phase["measured_mxu_tflops"])
+                    extra_env["BENCH_MEASURED_GBPS"] = \
+                        str(phase["measured_hbm_gbps"])
+                else:
+                    phase["calibration_unreliable"] = True
+                    print("bench: calibration outside plausible range — "
+                          "later phases use datasheet peaks only",
+                          file=sys.stderr)
+            result[key] = phase
+            _write_record(partial_path, result)       # raw phase map
+            _write_record(results_path,
+                          _assemble_final(result, errors))
+            print(f"bench: phase {name} done in {wall:.0f}s", file=sys.stderr)
+    except KeyboardInterrupt:
+        interrupted = name
+        errors["__interrupted__"] = f"interrupted during phase {name}"
+        print(f"bench: interrupted during {name} — emitting the record of "
+              f"all completed phases", file=sys.stderr)
+
+    final = _assemble_final(result, errors)
+    if interrupted is not None:
+        final["interrupted_during"] = interrupted
+    _write_record(results_path, final)
     print(json.dumps(final))
 
 

@@ -414,6 +414,28 @@ def _current_trace_state():
     return get_opaque_trace_state()
 
 
+_warned_missing_trace_ref = False
+
+
+def _check_trace_ref(state):
+    """One-time canary: dead-trace pruning leans on the PRIVATE
+    ``OpaqueTraceState._trace_ref`` weakref.  If a JAX upgrade renames it,
+    the ``getattr`` fallback below degrades to "always live" — correct but
+    leak-prone (aborted traces' sends pin their tensors until a failing
+    recv) — and that regression must be VISIBLE, not silent.  Guarded by a
+    unit test too (tests/unit/test_comm.py)."""
+    global _warned_missing_trace_ref
+    if _warned_missing_trace_ref or hasattr(state, "_trace_ref"):
+        return
+    _warned_missing_trace_ref = True
+    logger.warning(
+        "OpaqueTraceState._trace_ref is missing on this JAX version — "
+        "dead-trace pruning of queued send()s is disabled (every queued "
+        "send reads as live).  Aborted traces' sends now persist until a "
+        "failing recv; update _prune_dead_sends for the new "
+        "OpaqueTraceState internals.")
+
+
 def _prune_dead_sends():
     """Drop queued sends whose trace has been garbage-collected (an aborted
     or completed-without-recv trace).  ``OpaqueTraceState`` holds a WEAKREF
@@ -421,6 +443,8 @@ def _prune_dead_sends():
     jit) is never touched, but repeated aborted traces cannot accumulate
     entries (each pinning its traced tensor) for the life of the process.
     Called opportunistically from the happy path of send()/recv()."""
+    if _pending_send:
+        _check_trace_ref(_pending_send[0][0])
     # identity-based filtering: tuple equality would compare the queued
     # TRACED tensors (ambiguous truth value / leaked-tracer errors)
     dead_ids = {id(e) for e in _pending_send

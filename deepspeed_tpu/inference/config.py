@@ -7,6 +7,7 @@ from typing import Any, Dict, Optional
 
 from pydantic import Field
 
+from deepspeed_tpu.runtime.compile_cache import CompileCacheConfig
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 
 # Canonical dtype-string spellings ("torch.float16", "fp16", "half", ... →
@@ -78,6 +79,10 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # transients to O(batch x chunk) via the Pallas chunk kernel — the
     # big-batch / long-prompt serving enabler (Transformer.prefill_chunked)
     prefill_chunk_size: Optional[Any] = "auto"
+    # persistent compile/executable cache (runtime/compile_cache.py,
+    # docs/compile_cache.md): same block shape as the training config's
+    compile_cache: CompileCacheConfig = Field(
+        default_factory=CompileCacheConfig)
 
     def model_post_init(self, _ctx):
         if self.mp_size is not None and self.tensor_parallel.tp_size == 1:

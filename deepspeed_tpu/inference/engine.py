@@ -33,6 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
 from deepspeed_tpu.parallel import topology as topo_mod
+from deepspeed_tpu.runtime import compile_cache as compile_cache_mod
 from deepspeed_tpu.runtime.zero.partition import build_sharding_plan
 from deepspeed_tpu.runtime.config import ZeroConfig
 from deepspeed_tpu.tools.lint.hotpath import hot_path
@@ -65,6 +66,11 @@ class InferenceEngine:
         self._compiled = {}
         self._workspace = KVCacheWorkspace(model)
         self._aot = {}
+        self._tags = {}          # id(jit fn) -> stable program tag
+        # persistent compile/executable cache (None = disabled: the AOT
+        # path below still compiles per process, just without disk reuse)
+        self._program_cache = compile_cache_mod.ProgramCache.from_config(
+            self._config.compile_cache)
         self._rng = jax.random.key(0)
         if params is not None:
             self.set_params(params)
@@ -249,6 +255,7 @@ class InferenceEngine:
             carry_params=self._quantizer is not None
             and self._quantizer.materializing_dequant,
             prefill_chunk=prefill_chunk, external_prefill=external_prefill)
+        self._tags[id(self._compiled[key])] = key
         return self._compiled[key]
 
     def _prefill_chunk_for(self, batch_size, prompt_len):
@@ -257,10 +264,18 @@ class InferenceEngine:
             return None
         if cfg == "auto":
             return default_prefill_chunk(batch_size, prompt_len)
-        # the chunk kernel's VMEM accumulator bounds C at 512; a larger
-        # configured chunk would silently fall to the dense attend path
-        # (the [B,H,S,S_max] fp32 transient chunking exists to avoid)
-        c = min(int(cfg), 512)
+        # user-specified chunk: align like the fused-write checks do —
+        # round UP to a multiple of 8 (Mosaic's sublane granularity; the
+        # chunk kernel's q block and the cache-pad arithmetic both assume
+        # 8-row alignment) with a floor of 8, and cap at 512 (the kernel's
+        # VMEM accumulator bound; a larger chunk would silently fall to the
+        # dense attend path whose [B,H,S,S_max] fp32 transient this
+        # chunking exists to avoid)
+        c = min(512, max(8, -(-int(cfg) // 8) * 8))
+        if c != int(cfg):
+            from deepspeed_tpu.utils.logging import warning_once
+            warning_once(f"prefill_chunk_size={cfg} adjusted to {c} "
+                         f"(multiple of 8, min 8, max 512)")
         return c if c < prompt_len else None
 
     @hot_path("inference.generate")
@@ -320,6 +335,22 @@ class InferenceEngine:
             self._workspace.give_back(cache)
         return out
 
+    def _get_chunk_fn(self, C, B):
+        """The per-chunk prefill executable of the split-prefill path (one
+        donated-cache program replayed for every chunk)."""
+        ck = ("chunkfill", C, B)
+        if ck not in self._compiled:
+            module, deq = self.module, self._deq
+
+            @hot_path("inference.prefill_chunk")
+            def chunk_step(params, cache, chunk_ids, start, logits_at):
+                return module.apply(deq(params), chunk_ids, cache, start,
+                                    method=type(module).decode,
+                                    logits_at=logits_at)
+            self._compiled[ck] = jax.jit(chunk_step, donate_argnums=(1,))
+            self._tags[id(self._compiled[ck])] = ck
+        return self._compiled[ck]
+
     def _generate_split(self, input_ids, max_new_tokens, do_sample,
                         temperature, top_k, top_p, eos_token_id, rng,
                         attention_mask, chunk):
@@ -332,17 +363,7 @@ class InferenceEngine:
         n = -(-P // C)
         cache = self._workspace.take(
             B, required_cache_len(P, max_new_tokens, C), self.compute_dtype)
-        ck = ("chunkfill", C, B)
-        if ck not in self._compiled:
-            module, deq = self.module, self._deq
-
-            @hot_path("inference.prefill_chunk")
-            def chunk_step(params, cache, chunk_ids, start, logits_at):
-                return module.apply(deq(params), chunk_ids, cache, start,
-                                    method=type(module).decode,
-                                    logits_at=logits_at)
-            self._compiled[ck] = jax.jit(chunk_step, donate_argnums=(1,))
-        chunk_fn = self._compiled[ck]
+        chunk_fn = self._get_chunk_fn(C, B)
         ids_pad = jnp.pad(input_ids, ((0, 0), (0, n * C - P)))
         if attention_mask is not None:
             last = jnp.sum(attention_mask.astype(jnp.int32), axis=1) - 1
@@ -387,25 +408,51 @@ class InferenceEngine:
         silently switches to staging buffers and decode collapses ~8x
         (docs/performance.md, "measure the cliff"); the reference's
         workspace allocator bounds-checks the same way
-        (``inference_context.h:24-87``)."""
-        sig = (id(fn),) + tuple((l.shape, str(l.dtype))
-                                for l in jax.tree.leaves(args))
+        (``inference_context.h:24-87``).  With the ``compile_cache`` block
+        enabled, the executable is reloaded from / persisted to the
+        on-disk store (runtime/compile_cache.py), so a warm process skips
+        XLA compilation entirely."""
+        sig = (id(fn),) + compile_cache_mod.abstract_signature(args)
         compiled = self._aot.get(sig)
         if compiled is None:
-            try:
-                compiled = fn.lower(*args).compile()
-            except Exception as e:
+            compiled, _, _ = self._aot_compile(fn, args)
+            if compiled is None:
                 # AOT path is an optimization + guardrail; never let it
                 # block generation (fall back to the plain jit call)
-                logger.debug(f"AOT compile failed ({e}); jit fallback")
                 self._aot[sig] = fn
                 return fn(*args)
-            # guard BEFORE caching: under strict_memory every retry with
-            # the same over-budget signature must refuse again, not find
-            # a cached executable and run unguarded
-            self._guard_memory(compiled)
             self._aot[sig] = compiled
         return compiled(*args)
+
+    def _cache_context(self):
+        """Engine facts that change compiled programs but not arg shapes —
+        part of every executable-store key."""
+        q = self._config.quant
+        return (repr(getattr(self.module, "config",
+                             type(self.module).__name__)),
+                self.compute_dtype.__name__,
+                tuple(sorted(dict(self.mesh.shape).items())),
+                (q.enabled, q.bits, q.group_size, q.per_channel))
+
+    def _aot_compile(self, fn, args):
+        """Lower+compile ``fn`` for ``args`` (through the executable store
+        when enabled), memory-guard the result.  Returns ``(compiled,
+        compile_seconds, store_hit)`` — compiled is None on failure.
+        ``args`` may be abstract (``ShapeDtypeStruct``) — warmup path."""
+        tag = self._tags.get(id(fn))
+        compiled, dt, hit = compile_cache_mod.aot_compile_with_store(
+            self._program_cache if tag is not None else None,
+            f"infer:{tag[0] if tag else 'untagged'}",
+            (tag, compile_cache_mod.abstract_signature(args),
+             self._cache_context()),
+            fn, args)
+        if compiled is None:
+            return None, 0.0, False
+        # guard BEFORE caching: under strict_memory every retry with
+        # the same over-budget signature must refuse again, not find
+        # a cached executable and run unguarded
+        self._guard_memory(compiled)
+        return compiled, dt, hit
 
     def _guard_memory(self, compiled):
         import os
@@ -436,6 +483,96 @@ class InferenceEngine:
         if self._config.strict_memory:
             raise RuntimeError(f"strict_memory: {msg}")
         logger.warning(msg)
+
+    # ------------------------------------------------------------------ #
+    # Warmup: pay all compiles up front (and once per machine, with the
+    # compile_cache block enabled)
+    # ------------------------------------------------------------------ #
+    def warmup(self, prompt_len, max_new_tokens, batch_sizes=(1,),
+               do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+               with_mask=False, monitor=None):
+        """AOT-compile every program a ``generate(prompt_len,
+        max_new_tokens)`` call will need, for each batch-size bucket —
+        including the split-prefill pair (per-chunk executable + decode-only
+        program) when the chunk policy routes that batch there.  Nothing
+        executes: arguments are abstract, so no HBM is touched beyond the
+        already-placed params.
+
+        Returns ``{program_name: compile_seconds}`` (0.0 = warm already /
+        executable-store hit).  ``monitor``: an optional
+        ``MonitorMaster``-like object; each program's compile time is
+        reported as a ``Compile/<name>_secs`` event."""
+        assert self._params is not None, \
+            "no parameters: set_params/init_params first"
+        report = {}
+        for B in batch_sizes:
+            report.update(self._warmup_one(
+                int(B), int(prompt_len), int(max_new_tokens),
+                bool(do_sample), float(temperature), int(top_k),
+                float(top_p), bool(with_mask)))
+        for name, dt in report.items():
+            log_dist(f"warmup[{name}]: "
+                     + ("cached" if dt == 0.0 else f"{dt:.1f}s"), ranks=[0])
+        if monitor is not None and getattr(monitor, "enabled", True):
+            monitor.write_events([(f"Compile/{name}_secs", dt, 0)
+                                  for name, dt in report.items()])
+        return report
+
+    precompile = warmup
+
+    def _warmup_one(self, B, P, new, do_sample, temperature, top_k, top_p,
+                    with_mask):
+        chunk = self._prefill_chunk_for(B, P)
+        n_chunks = -(-P // chunk) if chunk else 1
+        cache = jax.eval_shape(
+            lambda: self.module.init_cache(
+                B, required_cache_len(P, new, chunk), dtype=self.compute_dtype))
+        ids = jax.ShapeDtypeStruct((B, P), jnp.int32)
+        rng = jax.eval_shape(lambda: jax.random.key(0))
+        # concrete, WEAK-typed int32 — exactly what generate() builds from
+        # the default ``eos_token_id=-1`` (a ShapeDtypeStruct would be
+        # strong-typed and the warmed executable would refuse the call)
+        eos = jnp.asarray(-1)
+        mask = jax.ShapeDtypeStruct((B, P), jnp.int32) if with_mask else None
+
+        def warm(fn, args, name):
+            sig = (id(fn),) + compile_cache_mod.abstract_signature(args)
+            if sig in self._aot:
+                return {name: 0.0}
+            compiled, dt, hit = self._aot_compile(fn, args)
+            if compiled is None:
+                logger.warning(f"warmup: {name} failed to AOT-compile — "
+                               f"it will compile on first use instead")
+                return {}
+            self._aot[sig] = compiled
+            return {name: 0.0 if hit else dt}
+
+        report = {}
+        if n_chunks > 1:
+            C = int(chunk)
+            chunk_fn = self._get_chunk_fn(C, B)
+            cargs = (self._params, cache, jax.ShapeDtypeStruct((B, C), jnp.int32),
+                     jax.ShapeDtypeStruct((), jnp.int32),
+                     jax.ShapeDtypeStruct((B,), jnp.int32))
+            report.update(warm(chunk_fn, cargs, f"prefill_chunk:b{B}c{C}"))
+            # the decode-only program consumes the chunk program's
+            # last-position logits — eval_shape gives their exact
+            # shape/dtype (and the cache's post-donation abstract value)
+            logits, cache = jax.eval_shape(chunk_fn, *cargs)
+            fn = self._get_generate(P, new, do_sample, temperature, top_k,
+                                    top_p, with_mask=with_mask,
+                                    external_prefill=True)
+            args = (self._params, cache, ids, rng, eos, mask, logits)
+            report.update(warm(fn, args, f"decode:b{B}p{P}n{new}"))
+        else:
+            fn = self._get_generate(P, new, do_sample, temperature, top_k,
+                                    top_p, with_mask=with_mask,
+                                    prefill_chunk=chunk)
+            args = (self._params, cache, ids, rng, eos)
+            if with_mask:
+                args += (mask,)
+            report.update(warm(fn, args, f"generate:b{B}p{P}n{new}"))
+        return report
 
 
 def _unflatten_flax_paths(flat):

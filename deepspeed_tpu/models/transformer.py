@@ -406,6 +406,18 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
     covers both causality and the unwritten cache tail.  TPU-native analog
     of the reference ``softmax_context`` KV-cache op
     (``csrc/transformer/inference/csrc/pt_binding.cpp``).
+
+    PER-ROW CONTIGUITY (the ``1 < S <= 512`` Pallas chunk branch): the
+    chunk kernel receives only each row's FIRST position
+    (``starts = q_positions[:, 0]``) and derives the rest as
+    ``starts[b] + iota(S)`` — so when that branch is taken, every row's
+    positions must be contiguous and ascending
+    (``q_positions[b, i] == q_positions[b, 0] + i``), which is exactly
+    what ``prefill_chunked`` / multi-token decode feed it.  Gapped or
+    reordered positions would silently diverge from the dense fallback's
+    per-position mask (regression-tested against the dense path in
+    tests/unit/test_decode_attention.py); such callers must route to the
+    dense path (pass a ``bias``/``window``, or S > 512).
     """
     B, S, H, D = q.shape
     S_max, KVH = k_cache.shape[-2], k_cache.shape[-1] // D

@@ -238,7 +238,14 @@ def _decode_kernel(len_ref, layer_ref, q_ref, k_ref, v_ref, *rest,
             # whole decode step at bs64).  The stripe's other 7 rows are
             # merged from the raw input slab (loaded for scores anyway);
             # Mosaic accepts the dynamic 8-aligned ref read.
-            row = (length - 1) % block_k
+            # Clamp: a zero-length row (invalid input — lengths INCLUDE
+            # this step's token, so the minimum is 1) would compute
+            # row = (-1) % block_k = block_k-1 and merge the slab's FAR
+            # stripe into the pinned rows 0-7 of the output (the output
+            # index map clamps to stripe 0), silently corrupting the
+            # cache head.  Clamped, length=0 degenerates to the benign
+            # length=1 write at row 0.
+            row = jnp.maximum(length - 1, 0) % block_k
             base = (row // 8) * 8
             off = row - base
             sel = jax.lax.broadcasted_iota(
@@ -494,7 +501,10 @@ def decode_attention(q, k_cache, v_cache, lengths,
     the caches, returned as ALIASED outputs (``input_output_aliases`` —
     the in-place workspace write of the reference's ``inference_context``)
     — and substitutes the fresh row into this step's own attention.  The
-    caller must then NOT pre-write the cache.  Returns
+    caller must then NOT pre-write the cache, and every ``lengths[b]``
+    must be >= 1 (it counts the fresh row); a zero-length row is clamped
+    to the length-1 write position in-kernel instead of corrupting cache
+    rows 0-7.  Returns
     ``(out, k_cache, v_cache[, k_scale, v_scale])`` instead of ``out``.
     Measured: the out-of-kernel dynamic-update-slice chain interacting
     with the kernel's cache reads makes XLA copy the multi-GB cache

@@ -1,0 +1,348 @@
+"""Persistent compilation & executable cache — compile once per machine.
+
+Compilation is this framework's dominant cold-path cost: every train step,
+prefill chunk and unrolled decode program is a multi-minute XLA compile at
+OPT-1.3B+ scale (the round-5 bench lost its whole record to ONE ~40-min
+cold compile).  This module makes compilation a per-machine cost instead of
+a per-process cost, at two layers:
+
+1. **Persistent XLA compilation cache** (:func:`configure_persistent_cache`)
+   — JAX's on-disk cache keyed by the optimized HLO + compile options, under
+   a framework-owned directory.  Transparent: any jit anywhere in the
+   process benefits.  Hits/misses are counted through JAX's monitoring
+   events (:func:`stats`).
+2. **Serialized executables** (:class:`ExecutableStore`) — AOT-compiled
+   ``jax.stages.Compiled`` programs (``jax.experimental
+   .serialize_executable``) stored whole, keyed by a framework cache key
+   (:func:`cache_key`: program tag + abstract arg signature + engine
+   context) and fingerprinted by jax/jaxlib version, backend, device kind &
+   count and ``XLA_FLAGS``.  A warm process skips tracing AND lowering AND
+   compilation; any mismatch or load error falls back to a fresh compile
+   (the cache can only ever cost a retrace, never correctness).
+
+Engines consume both through :class:`ProgramCache` (built from the
+``compile_cache`` config block, see ``docs/compile_cache.md``) and expose
+``warmup()``/``precompile()`` so all shape buckets compile up front with
+per-program compile times reported through the monitor.
+
+Invalidation: executable entries are dropped (ignored) whenever the
+fingerprint changes; the XLA cache is content-addressed and never stale.
+Delete the cache directory to reclaim space — both layers rebuild on the
+next cold run.
+"""
+
+import hashlib
+import json
+import os
+import pickle
+import time
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+from deepspeed_tpu.utils.logging import logger, log_dist
+
+
+class CompileCacheConfig(DeepSpeedConfigModel):
+    """``compile_cache`` config block (shared by the training and inference
+    engines; see ``docs/compile_cache.md``)."""
+    enabled: bool = False
+    # framework-owned cache root; None → $DSTPU_COMPILE_CACHE_DIR or
+    # ~/.cache/deepspeed_tpu/compile_cache
+    cache_dir: Optional[str] = None
+    # below this, XLA-cache writes are skipped (tiny programs recompile
+    # faster than they deserialize); jax default is 1s
+    min_compile_time_secs: float = 1.0
+    # serialize/reload whole AOT executables (layer 2 above)
+    executables: bool = True
+    # executable store directory; None → <cache_dir>/executables
+    executable_dir: Optional[str] = None
+
+
+def default_cache_dir():
+    return os.environ.get("DSTPU_COMPILE_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "deepspeed_tpu", "compile_cache")
+
+
+# --------------------------------------------------------------------- #
+# Cache-hit accounting (process-global; read deltas, not absolutes)
+# --------------------------------------------------------------------- #
+class CacheStats:
+    """Counters for both cache layers.  ``persistent_*`` come from JAX's
+    monitoring events (the on-disk XLA cache); ``executable_*`` from the
+    framework's :class:`ExecutableStore`."""
+
+    def __init__(self):
+        self.persistent_requests = 0     # compiles that consulted the cache
+        self.persistent_hits = 0
+        self.executable_hits = 0
+        self.executable_misses = 0
+        self.executable_mismatches = 0   # fingerprint said "not this build"
+        self.executable_saves = 0
+        self.executable_errors = 0
+        self.compile_seconds: Dict[str, float] = {}  # tag -> last compile time
+
+    def snapshot(self):
+        d = {k: v for k, v in self.__dict__.items()
+             if isinstance(v, (int, float))}
+        d["compile_seconds"] = dict(self.compile_seconds)
+        return d
+
+
+_STATS = CacheStats()
+
+
+def stats() -> CacheStats:
+    return _STATS
+
+
+_listener_registered = False
+
+
+def _on_jax_event(event, **kwargs):
+    if event == "/jax/compilation_cache/compile_requests_use_cache":
+        _STATS.persistent_requests += 1
+    elif event == "/jax/compilation_cache/cache_hits":
+        _STATS.persistent_hits += 1
+
+
+def _register_jax_listener():
+    global _listener_registered
+    if _listener_registered:
+        return
+    try:
+        from jax._src import monitoring
+        monitoring.register_event_listener(_on_jax_event)
+        _listener_registered = True
+    except Exception as e:      # private API — accounting is best-effort
+        logger.debug(f"compile-cache hit accounting unavailable: {e}")
+
+
+# --------------------------------------------------------------------- #
+# Layer 1: the persistent XLA compilation cache
+# --------------------------------------------------------------------- #
+_configured_dir = None
+
+
+def configure_persistent_cache(cache_dir=None, min_compile_time_secs=None):
+    """Point JAX's persistent compilation cache at a framework-owned
+    directory (idempotent; process-wide).  Returns the directory."""
+    global _configured_dir
+    import jax
+    cache_dir = cache_dir or default_cache_dir()
+    os.makedirs(cache_dir, exist_ok=True)
+    # the XLA cache dir is PROCESS-GLOBAL: re-pointing it (a second engine
+    # with a different cache_dir, or a user-set jax_compilation_cache_dir)
+    # is last-wins and fragments the cache — allowed, but never silent
+    current = jax.config.jax_compilation_cache_dir
+    if current not in (None, cache_dir):
+        logger.warning(
+            f"compile_cache: re-pointing the process-global XLA "
+            f"compilation cache from {current} to {cache_dir} (the dir is "
+            f"one-per-process; every engine and jit in this process now "
+            f"writes there — use one cache_dir per process to avoid "
+            f"fragmenting the cache)")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    if min_compile_time_secs is not None:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_secs))
+    _register_jax_listener()
+    if _configured_dir is None:
+        log_dist(f"persistent compilation cache at {cache_dir}", ranks=[0])
+    _configured_dir = cache_dir
+    return cache_dir
+
+
+def deconfigure_persistent_cache():
+    """Undo :func:`configure_persistent_cache` — for scripts/harnesses that
+    must detach the process from a temporary cache directory before it is
+    deleted (the dir is process-global; JAX would otherwise keep writing
+    there)."""
+    global _configured_dir
+    import jax
+    jax.config.update("jax_compilation_cache_dir", None)
+    _configured_dir = None
+
+
+# --------------------------------------------------------------------- #
+# Cache keys
+# --------------------------------------------------------------------- #
+def runtime_fingerprint():
+    """Everything that invalidates a serialized executable: compiler
+    version, backend, device model & count, and compiler flags.  (The
+    program itself is in the cache key, not the fingerprint.)"""
+    import jax
+    import jaxlib
+    dev = jax.devices()[0]
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", "unknown"),
+        "n_devices": jax.device_count(),
+        "n_processes": jax.process_count(),
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+    }
+
+
+def abstract_signature(tree):
+    """(shape, dtype, weak_type) of every array leaf — the shape/dtype half
+    of a program's identity (topology/dtype context rides in the key
+    parts).  weak_type matters: an executable compiled for a weak-typed
+    scalar refuses a strong-typed one of the same dtype at call time."""
+    import jax
+    return tuple((tuple(l.shape), str(l.dtype),
+                  bool(getattr(l, "weak_type", False)))
+                 for l in jax.tree.leaves(tree) if hasattr(l, "shape"))
+
+
+def cache_key(tag, *parts, fingerprint=None):
+    """Stable hex key for one compiled program: tag + context parts +
+    runtime fingerprint, hashed.  Parts are ``repr``'d — pass only values
+    with deterministic reprs (tuples, strings, numbers, dataclasses)."""
+    payload = {"tag": str(tag),
+               "parts": [repr(p) for p in parts],
+               "fp": fingerprint or runtime_fingerprint()}
+    h = hashlib.sha256(json.dumps(payload, sort_keys=True,
+                                  default=repr).encode())
+    return h.hexdigest()[:40]
+
+
+# --------------------------------------------------------------------- #
+# Layer 2: serialized executables
+# --------------------------------------------------------------------- #
+class ExecutableStore:
+    """On-disk store of serialized ``jax.stages.Compiled`` executables.
+
+    Layout: ``<dir>/<key>.bin`` (pickled ``serialize_executable.serialize``
+    triple) + ``<dir>/<key>.json`` (fingerprint metadata, written LAST so a
+    half-written entry is never loadable).  Every failure path is a miss,
+    never an error to the caller."""
+
+    def __init__(self, directory, fingerprint=None):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._fp = fingerprint or runtime_fingerprint()
+
+    def _paths(self, key):
+        base = os.path.join(self.directory, key)
+        return base + ".bin", base + ".json"
+
+    def load(self, key):
+        """Deserialized executable, or None (miss / mismatch / error)."""
+        bin_path, meta_path = self._paths(key)
+        if not (os.path.exists(bin_path) and os.path.exists(meta_path)):
+            _STATS.executable_misses += 1
+            return None
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+            if meta.get("fingerprint") != self._fp:
+                _STATS.executable_mismatches += 1
+                _STATS.executable_misses += 1
+                logger.debug(
+                    f"executable cache {key}: fingerprint mismatch "
+                    f"(entry {meta.get('fingerprint')} vs live {self._fp}) "
+                    f"— recompiling")
+                return None
+            with open(bin_path, "rb") as f:
+                payload, in_tree, out_tree = pickle.loads(f.read())
+            from jax.experimental import serialize_executable
+            exe = serialize_executable.deserialize_and_load(
+                payload, in_tree, out_tree)
+        except Exception as e:
+            _STATS.executable_errors += 1
+            _STATS.executable_misses += 1
+            logger.debug(f"executable cache load failed for {key}: {e}")
+            return None
+        _STATS.executable_hits += 1
+        return exe
+
+    def save(self, key, compiled) -> bool:
+        """Serialize + persist; atomic (tmp + rename), meta written last."""
+        bin_path, meta_path = self._paths(key)
+        try:
+            from jax.experimental import serialize_executable
+            blob = pickle.dumps(serialize_executable.serialize(compiled))
+            tmp = bin_path + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, bin_path)
+            tmp = meta_path + f".tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"fingerprint": self._fp, "key": key,
+                           "bytes": len(blob), "created": time.time()}, f)
+            os.replace(tmp, meta_path)
+        except Exception as e:
+            _STATS.executable_errors += 1
+            logger.debug(f"executable cache save failed for {key}: {e}")
+            return False
+        _STATS.executable_saves += 1
+        return True
+
+
+# --------------------------------------------------------------------- #
+# Engine facade
+# --------------------------------------------------------------------- #
+class ProgramCache:
+    """What an engine holds: the persistent-cache wiring plus (optionally)
+    an executable store, with per-program compile-time accounting."""
+
+    def __init__(self, config: CompileCacheConfig):
+        self.config = config
+        cache_dir = configure_persistent_cache(
+            config.cache_dir, config.min_compile_time_secs)
+        self.store = None
+        if config.executables:
+            self.store = ExecutableStore(
+                config.executable_dir
+                or os.path.join(cache_dir, "executables"))
+
+    @classmethod
+    def from_config(cls, config) -> Optional["ProgramCache"]:
+        """None when the block is absent/disabled — engines keep the plain
+        jit path untouched in that case."""
+        if config is None:
+            return None
+        if isinstance(config, dict):
+            config = CompileCacheConfig(**config)
+        if not config.enabled:
+            return None
+        return cls(config)
+
+    def get_or_compile(self, tag, key_parts, compile_fn):
+        """Returns ``(compiled, seconds, hit)``.  ``compile_fn`` runs only
+        on a store miss; its wall time is recorded under ``tag`` in
+        :func:`stats` and the fresh executable is persisted."""
+        key = cache_key(tag, *key_parts)
+        if self.store is not None:
+            exe = self.store.load(key)
+            if exe is not None:
+                log_dist(f"compile cache hit: {tag}", ranks=[0])
+                return exe, 0.0, True
+        t0 = time.perf_counter()
+        exe = compile_fn()
+        dt = time.perf_counter() - t0
+        _STATS.compile_seconds[str(tag)] = dt
+        if self.store is not None:
+            self.store.save(key, exe)
+        log_dist(f"compiled {tag} in {dt:.1f}s", ranks=[0])
+        return exe, dt, False
+
+
+def aot_compile_with_store(program_cache, tag, key_parts, fn, args):
+    """Lower+compile ``fn`` for ``args`` through ``program_cache``'s
+    executable store (or inline when it is None) — the one copy of the
+    AOT-with-jit-fallback block all three engines share.  Returns
+    ``(exe, seconds, hit)``; exe is None on any failure (warned — the
+    caller runs the plain jit call, which recompiles on its own clock, so
+    a failure must never masquerade as a 0.0s compile or a store hit)."""
+    t0 = time.perf_counter()
+    try:
+        if program_cache is not None:
+            return program_cache.get_or_compile(
+                tag, key_parts, lambda: fn.lower(*args).compile())
+        return fn.lower(*args).compile(), time.perf_counter() - t0, False
+    except Exception as e:
+        logger.warning(f"AOT compile of {tag} failed ({e}); falling back "
+                       f"to the plain jit call")
+        return None, 0.0, False

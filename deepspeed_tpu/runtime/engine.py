@@ -159,6 +159,12 @@ class DeepSpeedEngine:
         self._compiled = {}
         self._last_loss = None
         self.warn_unscaled_loss = True
+        # persistent compile/executable cache (runtime/compile_cache.py):
+        # None = disabled, the plain jit path below is untouched
+        from deepspeed_tpu.runtime.compile_cache import ProgramCache
+        self._program_cache = ProgramCache.from_config(
+            getattr(self._config, "compile_cache", None))
+        self._train_aot = {}     # abstract signature -> AOT executable
 
         # ZeRO-Offload (reference stage_1_and_2.py:1037 CPU-offload path /
         # stage3.py:1637 NVMe): host-resident fp32 masters + moments stepped
@@ -1056,6 +1062,111 @@ class DeepSpeedEngine:
                                None, None, None))
         return self._compiled[key]
 
+    def _run_fused_step(self, args):
+        """Execute the fused train step — through an AOT executable when
+        one exists (warmup() or the compile_cache executable store),
+        through the plain jit call otherwise (exactly the seed behavior
+        when the compile_cache block is off)."""
+        fused = self._get_fused_step()
+        if self._program_cache is None and not self._train_aot:
+            return fused(*args)
+        from deepspeed_tpu.runtime import compile_cache as cc
+        sig = cc.abstract_signature(args)
+        exe = self._train_aot.get(sig)
+        if exe is None:
+            exe, _, _ = self._train_exe_for(fused, args, sig)
+        return exe(*args)
+
+    def _train_key_parts(self, sig):
+        """Executable-store key context for the train step: everything that
+        changes the compiled program besides the arg shapes."""
+        import json as _json
+        cfg = _json.dumps(self._config._param_dict, sort_keys=True,
+                          default=repr)
+        return (sig, cfg,
+                repr(getattr(self.module, "config",
+                             type(self.module).__name__)),
+                tuple(sorted(dict(self.mesh.shape).items())),
+                type(self.optimizer).__name__,
+                type(self.loss_scaler).__name__)
+
+    def _train_exe_for(self, fused, args, sig):
+        """AOT-compile the fused step (consulting the executable store when
+        enabled); falls back to the jit callable itself on any failure.
+        Returns ``(exe, compile_seconds, store_hit)``."""
+        from deepspeed_tpu.runtime.compile_cache import aot_compile_with_store
+        exe, dt, hit = aot_compile_with_store(
+            self._program_cache, "train_step", self._train_key_parts(sig),
+            fused, args)
+        if exe is None:            # AOT failed (warned): plain jit call —
+            exe = fused            # no fake 0.0s compile event
+        else:
+            self._report_compile("train_step", dt, hit)
+        self._train_aot[sig] = exe
+        return exe, dt, hit
+
+    def _report_compile(self, name, seconds, cache_hit):
+        log_dist(f"compile[{name}]: "
+                 + ("executable-cache hit" if cache_hit
+                    else f"{seconds:.1f}s"), ranks=[0])
+        if self.monitor.enabled:
+            self.monitor.write_events(
+                [(f"Compile/{name}_secs", seconds, self.global_steps)])
+
+    def warmup(self, batch=None, data_iter=None):
+        """Pre-compile the fused whole-step train program for this batch's
+        shapes, reporting the compile time through the monitor — so the
+        multi-minute large-model compile is paid at a chosen moment (and,
+        with the ``compile_cache`` block enabled, once per machine) instead
+        of silently inside the first ``train_batch``.  Nothing executes and
+        no engine state advances; the batch (same ``[gas, micro, ...]``
+        stacked contract as ``train_batch``) is only used for shapes +
+        lazy param init.
+
+        Returns ``{"train_step": seconds}`` (0.0 = executable-store hit),
+        or ``{}`` on the offload / grouped-backward paths (those run the
+        3-call sequence whose programs compile per micro-step).
+
+        NOTE: ``data_iter`` is CONSUMED exactly like ``train_batch`` would
+        consume it (``gas`` micro-batches) — pass a throwaway/example
+        ``batch`` instead when every sample must reach training."""
+        gas = self.gradient_accumulation_steps()
+        n_groups = int(getattr(self._config.zero_config,
+                               "grad_partition_groups", 1) or 1)
+        if self._offload_cfg is not None or n_groups > 1:
+            # before touching data_iter: an engine this cannot warm must
+            # not eat a global batch of real training data on the way out
+            logger.warning("warmup(): offload/grouped engines run the "
+                           "3-call path — no fused step to precompile")
+            return {}
+        if batch is None:
+            mbs = [next(data_iter) for _ in range(gas)]
+            batch = jax.tree.map(lambda *xs: jnp.stack(xs), *mbs)
+        self._lazy_init((jax.tree.map(lambda x: x[0], batch),), {})
+        # same curriculum slice train_batch applies — without it the
+        # warmed signature would never match the sliced batch's and the
+        # first real step would recompile anyway
+        batch = self._curriculum_slice(batch, 2)
+        batch = jax.tree.map(
+            lambda x: jax.device_put(
+                jnp.asarray(x),
+                NamedSharding(self.mesh,
+                              P(None, *(self._data_sharding(x.ndim - 1)
+                                        .spec)))),
+            batch)
+        lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+        step_no = jnp.asarray(self.global_steps + 1, jnp.int32)
+        args = (self._params, self._opt_state, self._scaler_state,
+                lr, step_no, self._rng, batch)
+        from deepspeed_tpu.runtime import compile_cache as cc
+        sig = cc.abstract_signature(args)
+        if sig in self._train_aot:
+            return {"train_step": 0.0}
+        _, dt, hit = self._train_exe_for(self._get_fused_step(), args, sig)
+        return {"train_step": 0.0 if hit else dt}
+
+    precompile = warmup
+
     @hot_path("runtime.train_batch")
     def train_batch(self, data_iter=None, batch=None):
         """One full global-batch step as a single XLA program (analog of
@@ -1097,9 +1208,10 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         lr = jnp.asarray(self.get_lr()[0], jnp.float32)
         step_no = jnp.asarray(self.global_steps + 1, jnp.int32)
+        args = (self._params, self._opt_state, self._scaler_state,
+                lr, step_no, self._rng, batch)
         (self._params, self._opt_state, self._scaler_state, loss, gnorm) = \
-            self._get_fused_step()(self._params, self._opt_state, self._scaler_state,
-                                   lr, step_no, self._rng, batch)
+            self._run_fused_step(args)
         self._last_global_grad_norm = gnorm
         self._last_loss = loss
         self.global_steps += 1

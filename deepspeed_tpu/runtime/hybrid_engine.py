@@ -36,6 +36,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         self._infer_params = None
         self._infer_params_step = -1
         self._gen_compiled = {}
+        self._gen_aot = {}       # (id(fn),) + abstract sig -> AOT executable
         self._cast_fn = None
         self._lora_spec = None
         self._lora_fused = False
@@ -77,6 +78,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         self._infer_params_step = -1
         self._quant_cast_fn = None
         self._gen_compiled = {}
+        self._gen_aot = {}
 
     def _rollout_deq(self, params):
         """In-trace dequantization hook for the rollout program (identity
@@ -279,20 +281,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         key = (input_ids.shape[1], int(max_new_tokens), bool(do_sample),
                float(temperature), int(top_k), float(top_p),
                attention_mask is not None, chunk)
-        if key not in self._gen_compiled:
-            # carry the rollout view through the decode scan only when its
-            # dequant materializes full weights (see WeightQuantization
-            # .materializing_dequant); the plain bf16 view stays an
-            # argument buffer (no loop-temp copy)
-            self._gen_compiled[key] = make_generate_fn(
-                self.module, self.compute_dtype, input_ids.shape[1],
-                int(max_new_tokens), bool(do_sample), float(temperature),
-                int(top_k), float(top_p),
-                param_transform=self._rollout_deq,
-                with_mask=attention_mask is not None,
-                carry_params=self._rollout_quantizer is not None
-                and self._rollout_quantizer.materializing_dequant,
-                prefill_chunk=chunk)
+        self._get_rollout_fn(key)
         params = self._inference_view()
         if getattr(self, "_gen_workspace", None) is None:
             # donated KV-cache workspace, shared across rollouts (see
@@ -306,11 +295,113 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         args = (params, cache, input_ids, rng, jnp.asarray(eos_token_id))
         if attention_mask is not None:
             args += (jnp.asarray(attention_mask),)
-        out, cache = self._gen_compiled[key](*args)
+        out, cache = self._run_rollout(self._gen_compiled[key], args, key)
         self._gen_workspace.give_back(cache)
         out.block_until_ready()  # tpu-lint: disable=TL001 -- rollout latency metric needs the full program, once per rollout not per token
         self._generate_latency += time.time() - t0
         return out
+
+    def _get_rollout_fn(self, key):
+        """Build (or fetch) the rollout generation program for ``key`` =
+        (prompt_len, max_new, do_sample, temperature, top_k, top_p,
+        with_mask, chunk)."""
+        if key not in self._gen_compiled:
+            from deepspeed_tpu.inference.engine import make_generate_fn
+            P, new, do_sample, temperature, top_k, top_p, with_mask, chunk \
+                = key
+            # carry the rollout view through the decode scan only when its
+            # dequant materializes full weights (see WeightQuantization
+            # .materializing_dequant); the plain bf16 view stays an
+            # argument buffer (no loop-temp copy)
+            self._gen_compiled[key] = make_generate_fn(
+                self.module, self.compute_dtype, P, new, do_sample,
+                temperature, top_k, top_p,
+                param_transform=self._rollout_deq,
+                with_mask=with_mask,
+                carry_params=self._rollout_quantizer is not None
+                and self._rollout_quantizer.materializing_dequant,
+                prefill_chunk=chunk)
+        return self._gen_compiled[key]
+
+    def _run_rollout(self, fn, args, key):
+        """Execute a rollout program — through an AOT executable when one
+        exists (``warmup_rollout`` or the compile_cache executable store);
+        the plain jit call otherwise (seed behavior)."""
+        if self._program_cache is None and not self._gen_aot:
+            return fn(*args)
+        from deepspeed_tpu.runtime import compile_cache as cc
+        sig = (id(fn),) + cc.abstract_signature(args)
+        exe = self._gen_aot.get(sig)
+        if exe is None:
+            exe, _, _ = self._rollout_aot_compile(fn, args, key, sig)
+        return exe(*args)
+
+    def _rollout_aot_compile(self, fn, args, key, sig):
+        """Returns ``(exe, compile_seconds, store_hit)``."""
+        import json as _json
+        from deepspeed_tpu.runtime.compile_cache import aot_compile_with_store
+        q = self._rollout_quantizer
+        # same context discipline as _train_key_parts: mesh layout and the
+        # full engine config are part of the program's identity (the
+        # runtime fingerprint only sees device kind/count — two different
+        # shardings on the same host must not share an executable)
+        key_parts = (key, sig[1:],
+                     repr(getattr(self.module, "config",
+                                  type(self.module).__name__)),
+                     self.compute_dtype.__name__,
+                     None if q is None else q.bits,
+                     tuple(sorted(dict(self.mesh.shape).items())),
+                     _json.dumps(self._config._param_dict, sort_keys=True,
+                                 default=repr))
+        exe, dt, hit = aot_compile_with_store(
+            self._program_cache, "rollout", key_parts, fn, args)
+        if exe is None:            # AOT failed (warned): plain jit call —
+            exe = fn               # no fake 0.0s compile event
+        else:
+            self._report_compile("rollout", dt, hit)
+        self._gen_aot[sig] = exe
+        return exe, dt, hit
+
+    def warmup_rollout(self, batch_sizes, prompt_len, max_new_tokens,
+                       do_sample=False, temperature=1.0, top_k=0,
+                       top_p=1.0, with_mask=False):
+        """AOT-compile the rollout ``generate`` program for every batch-
+        size bucket (RLHF rollout sweeps run several), reporting per-
+        program compile time through the monitor.  Combine with
+        ``warmup()`` (the train step) to pay the whole hybrid loop's
+        compile cost up front — and, with the ``compile_cache`` block
+        enabled, once per machine.  ``with_mask=True`` warms the
+        right-padded-prompt variant (int32 masks — the usual RLHF rollout
+        input; masked and unmasked are DIFFERENT programs).  Returns
+        ``{program: seconds}`` (0.0 = store hit / already warm)."""
+        from deepspeed_tpu.inference.engine import required_cache_len
+        from deepspeed_tpu.runtime import compile_cache as cc
+        params = self._inference_view()
+        P, new = int(prompt_len), int(max_new_tokens)
+        key = (P, new, bool(do_sample), float(temperature), int(top_k),
+               float(top_p), bool(with_mask), None)
+        fn = self._get_rollout_fn(key)
+        report = {}
+        for B in batch_sizes:
+            B = int(B)
+            cache = jax.eval_shape(
+                lambda: self.module.init_cache(
+                    B, required_cache_len(P, new, None),
+                    dtype=self.compute_dtype))
+            args = (params, cache,
+                    jax.ShapeDtypeStruct((B, P), jnp.int32),
+                    jax.eval_shape(lambda: jax.random.key(0)),
+                    jnp.asarray(-1))
+            if with_mask:
+                args += (jax.ShapeDtypeStruct((B, P), jnp.int32),)
+            sig = (id(fn),) + cc.abstract_signature(args)
+            name = f"rollout:b{B}p{P}n{new}"
+            if sig in self._gen_aot:
+                report[name] = 0.0
+                continue
+            _, dt, hit = self._rollout_aot_compile(fn, args, key, sig)
+            report[name] = 0.0 if hit else dt
+        return report
 
 
 @partial(jax.jit, static_argnames=("sign",))  # tpu-lint: disable=TL002 -- input is the live master tree; donating it would kill the training copy

@@ -264,3 +264,43 @@ def test_nested_trace_pair_coexists_with_outer_send(topo):
     want[1] = -1.0 + 70.0
     np.testing.assert_allclose(np.asarray(out), want)
     assert not _pending_send
+
+
+def test_opaque_trace_state_has_trace_ref():
+    """The send/recv shim's dead-trace pruning leans on the PRIVATE
+    ``OpaqueTraceState._trace_ref`` weakref; its getattr fallback degrades
+    to "always live" (leak-prone) if a JAX upgrade renames it.  This
+    canary makes that regression LOUD: if it fails, update
+    ``comm._prune_dead_sends`` for the new OpaqueTraceState internals
+    (comm.py emits a one-time runtime warning for the same condition)."""
+    from deepspeed_tpu.utils.jax_compat import get_opaque_trace_state
+    state = get_opaque_trace_state()
+    assert hasattr(state, "_trace_ref"), (
+        "OpaqueTraceState._trace_ref is gone on this JAX version — "
+        "_prune_dead_sends now treats every queued send as live; port it "
+        "to the new trace-liveness internals")
+    # at top level the current trace is the eval trace and must be LIVE
+    assert state._trace_ref() is not None
+
+
+def test_prune_warns_once_when_trace_ref_missing():
+    """The runtime half of the canary: a queue whose entries lack
+    ``_trace_ref`` triggers ONE warning (not silence, not spam)."""
+    from deepspeed_tpu.comm import comm as comm_mod
+
+    class NoRefState:
+        pass
+
+    saved = list(comm_mod._pending_send)
+    warned = comm_mod._warned_missing_trace_ref
+    try:
+        comm_mod._warned_missing_trace_ref = False
+        comm_mod._pending_send[:] = [(NoRefState(), None, 0, ("edp",), 0)]
+        comm_mod._prune_dead_sends()
+        assert comm_mod._warned_missing_trace_ref
+        # entries without the weakref read as live → nothing pruned
+        assert len(comm_mod._pending_send) == 1
+        comm_mod._prune_dead_sends()          # second call: no re-warn path
+    finally:
+        comm_mod._pending_send[:] = saved
+        comm_mod._warned_missing_trace_ref = warned

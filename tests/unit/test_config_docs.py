@@ -46,6 +46,7 @@ def _blocks():
         "data_efficiency": rc.DataEfficiencyConfig,
         "autotuning": rc.AutotuningConfig,
         "nebula": rc.NebulaConfig,
+        "compile_cache": rc.CompileCacheConfig,
         "init_inference": DeepSpeedInferenceConfig,
         "init_inference.quant": QuantizationConfig,
     }

@@ -490,3 +490,60 @@ def test_fused_write_rejects_bad_blocks():
     with pytest.raises(ValueError, match="S_max % 8"):
         decode_attention(q, odd, odd, jnp.asarray([5], jnp.int32),
                          new_k=n, new_v=n)
+
+
+def test_fused_write_zero_length_row_clamped():
+    """A zero-length row (invalid input — lengths include the fresh token,
+    so the minimum is 1) must NOT corrupt cache rows 0-7: unclamped, its
+    in-kernel write row computes (-1) % block_k = block_k-1 and the far
+    stripe's stale rows get merged over the cache head (ADVICE round 5).
+    Clamped, it degenerates to the benign length=1 write at row 0 and
+    every other row of the stripe survives byte-for-byte."""
+    B, H, D, S_max = 3, 4, 16, 64
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, S_max, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, S_max, D)), jnp.float32)
+    ks, vs = to_smajor(k), to_smajor(v)
+    kn = rng.standard_normal((B, H, D)).astype(np.float32)
+    vn = rng.standard_normal((B, H, D)).astype(np.float32)
+    lengths = jnp.asarray([5, 0, 33], jnp.int32)      # row 1: zero-length
+    _, ko, vo = decode_attention(q, ks, vs, lengths, block_k=32,
+                                 new_k=jnp.asarray(kn),
+                                 new_v=jnp.asarray(vn))
+    ko, vo = np.asarray(ko), np.asarray(vo)
+    # the zero-length row's write clamps to position 0; positions 1-7 (the
+    # rest of its 8-row write stripe) and everything beyond stay intact
+    np.testing.assert_array_equal(ko[1, 1:], np.asarray(ks)[1, 1:])
+    np.testing.assert_array_equal(vo[1, 1:], np.asarray(vs)[1, 1:])
+    np.testing.assert_allclose(ko[1, 0], kn[1].reshape(-1), rtol=1e-6)
+    # the VALID rows still write at lengths-1 exactly
+    for b, pos in ((0, 4), (2, 32)):
+        np.testing.assert_allclose(ko[b, pos], kn[b].reshape(-1), rtol=1e-6)
+        other = np.delete(np.arange(S_max), pos)
+        np.testing.assert_array_equal(ko[b, other], np.asarray(ks)[b, other])
+
+
+# --------------------------------------------------------------------- #
+# cached_attention chunk-branch contract (models/transformer.py)
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("starts", [(0, 0), (3, 11)])
+def test_cached_attention_chunk_branch_matches_dense(starts):
+    """The ``1 < S <= 512`` Pallas chunk branch of cached_attention derives
+    row positions as ``q_positions[:, 0] + iota`` — for its documented
+    contract (per-row CONTIGUOUS ascending positions, possibly different
+    per row) it must agree with the dense einsum fallback, which masks per
+    position."""
+    B, S, H, D, S_max = 2, 8, 4, 16, 64
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, S_max, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, S_max, D)), jnp.float32)
+    ks, vs = to_smajor(k), to_smajor(v)
+    q_pos = jnp.asarray([[s + i for i in range(S)] for s in starts],
+                        jnp.int32)
+    got = cached_attention(q, ks, vs, q_pos)          # chunk kernel branch
+    want = xla_cached_attention(q, ks, vs, q_pos)     # dense fallback
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)

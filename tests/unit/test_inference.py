@@ -491,3 +491,37 @@ def test_split_prefill_generation_matches_one_pass(model_and_params):
     got_m = np.asarray(eng.generate(ids, max_new_tokens=4,
                                     attention_mask=mask))
     np.testing.assert_array_equal(got_m, want_m)
+
+
+def test_prefill_chunk_size_alignment(model_and_params):
+    """User-specified prefill_chunk_size is rounded UP to a multiple of 8
+    (floor 8, cap 512 — the Mosaic chunk kernel's alignment and VMEM
+    bounds, mirroring the fused-write checks) before reaching the kernel;
+    auto/off behavior is untouched (ADVICE round 5)."""
+    model, params, ids = model_and_params
+
+    def chunk_for(cfg_value, batch=2, prompt=2048):
+        eng = deepspeed_tpu.init_inference(
+            model, config={"dtype": "float32",
+                           "prefill_chunk_size": cfg_value})
+        return eng._prefill_chunk_for(batch, prompt)
+
+    assert chunk_for(5) == 8            # rounded up from below the floor
+    assert chunk_for(100) == 104        # next multiple of 8
+    assert chunk_for(128) == 128        # already aligned: untouched
+    assert chunk_for(1000) == 512       # capped at the kernel's VMEM bound
+    assert chunk_for(0) is None         # 0/None/"off" still disable
+    assert chunk_for(None) is None
+    assert chunk_for("off") is None
+    assert chunk_for(16, prompt=12) is None   # chunk >= prompt → one-pass
+    # the rounded chunk still generates correctly end-to-end (prompt 12,
+    # chunk 5 → 8 → 2-chunk split prefill)
+    ref = deepspeed_tpu.init_inference(model, config={"dtype": "float32"})
+    ref.set_params(params)
+    want = np.asarray(ref.generate(ids, max_new_tokens=4))
+    eng = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32", "prefill_chunk_size": 5})
+    eng.set_params(params)
+    assert eng._prefill_chunk_for(*ids.shape) == 8
+    np.testing.assert_array_equal(
+        np.asarray(eng.generate(ids, max_new_tokens=4)), want)

@@ -9,6 +9,7 @@ from pydantic import Field
 
 from deepspeed_tpu.runtime.compile_cache import CompileCacheConfig
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+from deepspeed_tpu.runtime.fault.config import FaultConfig
 
 # Canonical dtype-string spellings ("torch.float16", "fp16", "half", ... →
 # "float16"); shared by init_inference's conversion and the engine's cast.
@@ -83,6 +84,12 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # docs/compile_cache.md): same block shape as the training config's
     compile_cache: CompileCacheConfig = Field(
         default_factory=CompileCacheConfig)
+    # fault tolerance / graceful degradation (runtime/fault/,
+    # docs/fault_tolerance.md): same block shape as the training
+    # config's.  ``enabled`` + ``max_retries`` bound-retry transient
+    # executable-load failures; ``enabled`` + ``bucket_downshift`` turns
+    # a strict_memory guard refusal into a batch split (see generate())
+    fault: FaultConfig = Field(default_factory=FaultConfig)
 
     def model_post_init(self, _ctx):
         if self.mp_size is not None and self.tensor_parallel.tp_size == 1:

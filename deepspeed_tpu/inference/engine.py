@@ -40,6 +40,13 @@ from deepspeed_tpu.tools.lint.hotpath import hot_path
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
+class MemoryGuardExceeded(RuntimeError):
+    """A generation program's compiled footprint exceeded
+    ``memory_guard_fraction`` of device memory under ``strict_memory``.
+    With the ``fault`` block's ``bucket_downshift`` on, ``generate``
+    catches this and splits the batch instead of failing the request."""
+
+
 class InferenceEngine:
 
     def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None,
@@ -72,6 +79,12 @@ class InferenceEngine:
         self._program_cache = compile_cache_mod.ProgramCache.from_config(
             self._config.compile_cache)
         self._rng = jax.random.key(0)
+        # fault/degradation accounting (docs/fault_tolerance.md):
+        # transient executable-load retries and strict_memory batch splits
+        self.fault_stats = {"exec_load_retries": 0, "bucket_downshifts": 0}
+        # signatures the memory guard refused under strict_memory —
+        # repeat requests at that bucket skip straight to the downshift
+        self._guard_refused = set()
         if params is not None:
             self.set_params(params)
         elif self._config.checkpoint is not None:
@@ -298,6 +311,41 @@ class InferenceEngine:
         if seed is not None:
             self._rng = jax.random.key(seed)
         self._rng, rng = jax.random.split(self._rng)
+        try:
+            return self._generate_once(
+                input_ids, max_new_tokens, do_sample, temperature, top_k,
+                top_p, eos_token_id, rng, attention_mask)
+        except MemoryGuardExceeded:
+            fcfg = getattr(self._config, "fault", None)
+            B = input_ids.shape[0]
+            if fcfg is None or not (fcfg.enabled and fcfg.bucket_downshift) \
+                    or B <= 1:
+                raise
+            # graceful degradation (fault.bucket_downshift): the request's
+            # batch bucket compiles over the memory guard — serve it as two
+            # sequential half-batches instead of failing.  Latency roughly
+            # doubles for this request; sampling streams differ from the
+            # unsplit run (each half draws its own keys).  Recursion
+            # bottoms out at batch 1, where the guard verdict is final.
+            half = B // 2
+            self.fault_stats["bucket_downshifts"] += 1
+            logger.warning(  # tpu-lint: disable=TL003 -- generate() is host-side dispatch (the jitted programs live in _get_generate); this handler runs after a compile refusal, never in-trace
+                f"strict_memory: generation batch {B} exceeds the memory "
+                f"guard — bucket-downshifting to {half}+{B - half} "
+                "sequential half-batches (fault.bucket_downshift)")
+            kw = dict(max_new_tokens=max_new_tokens, do_sample=do_sample,
+                      temperature=temperature, top_k=top_k, top_p=top_p,
+                      eos_token_id=eos_token_id)
+            mask = attention_mask
+            lo = self.generate(input_ids[:half], attention_mask=None
+                               if mask is None else mask[:half], **kw)
+            hi = self.generate(input_ids[half:], attention_mask=None
+                               if mask is None else mask[half:], **kw)
+            return jnp.concatenate([lo, hi], axis=0)
+
+    def _generate_once(self, input_ids, max_new_tokens, do_sample,
+                       temperature, top_k, top_p, eos_token_id, rng,
+                       attention_mask):
         B, P = input_ids.shape
         chunk = self._prefill_chunk_for(B, P)
         n_chunks = -(-P // chunk) if chunk else 1
@@ -413,9 +461,22 @@ class InferenceEngine:
         on-disk store (runtime/compile_cache.py), so a warm process skips
         XLA compilation entirely."""
         sig = (id(fn),) + compile_cache_mod.abstract_signature(args)
+        if sig in self._guard_refused:
+            # this signature's program was already compiled once and
+            # refused by the memory guard — refusing from memory spares
+            # every subsequent over-budget request the doomed multi-second
+            # XLA compile before its bucket downshift
+            raise MemoryGuardExceeded(
+                f"strict_memory: generation program for this signature was "
+                f"previously refused by the memory guard (batch "
+                f"{args[2].shape[0] if hasattr(args[2], 'shape') else '?'})")
         compiled = self._aot.get(sig)
         if compiled is None:
-            compiled, _, _ = self._aot_compile(fn, args)
+            try:
+                compiled, _, _ = self._aot_compile_resilient(fn, args)
+            except MemoryGuardExceeded:
+                self._guard_refused.add(sig)
+                raise
             if compiled is None:
                 # AOT path is an optimization + guardrail; never let it
                 # block generation (fall back to the plain jit call)
@@ -423,6 +484,35 @@ class InferenceEngine:
                 return fn(*args)
             self._aot[sig] = compiled
         return compiled(*args)
+
+    def _aot_compile_resilient(self, fn, args):
+        """``_aot_compile`` under the fault block's bounded
+        retry/backoff: a transient I/O failure while loading/persisting
+        an executable (shared stores on network filesystems flake)
+        retries ``fault.max_retries`` times; exhaustion degrades to the
+        plain jit path instead of failing the request.  A
+        :class:`MemoryGuardExceeded` refusal is NOT transient and
+        propagates immediately."""
+        fcfg = getattr(self._config, "fault", None)
+        if fcfg is None or not fcfg.enabled or fcfg.max_retries <= 0:
+            return self._aot_compile(fn, args)
+        from deepspeed_tpu.runtime.fault.retry import (
+            retry_call, retry_policy_from_config, TRANSIENT_IO_ERRORS)
+
+        def count(_attempt, _exc):
+            self.fault_stats["exec_load_retries"] += 1
+
+        try:
+            return retry_call(self._aot_compile, fn, args,
+                              label="inference executable load",
+                              on_retry=count,
+                              **retry_policy_from_config(fcfg))
+        except TRANSIENT_IO_ERRORS as e:
+            logger.warning(f"executable load still failing after "
+                           f"{fcfg.max_retries} retries "
+                           f"({type(e).__name__}: {e}) — degrading to the "
+                           "plain jit path for this program")
+            return None, 0.0, False
 
     def _cache_context(self):
         """Engine facts that change compiled programs but not arg shapes —
@@ -439,6 +529,8 @@ class InferenceEngine:
         when enabled), memory-guard the result.  Returns ``(compiled,
         compile_seconds, store_hit)`` — compiled is None on failure.
         ``args`` may be abstract (``ShapeDtypeStruct``) — warmup path."""
+        from deepspeed_tpu.runtime.fault import inject as fault_inject
+        fault_inject.fire("infer.executable_load")
         tag = self._tags.get(id(fn))
         compiled, dt, hit = compile_cache_mod.aot_compile_with_store(
             self._program_cache if tag is not None else None,
@@ -481,7 +573,7 @@ class InferenceEngine:
                f"shorter max cache (docs/performance.md, 'measure the "
                f"cliff').")
         if self._config.strict_memory:
-            raise RuntimeError(f"strict_memory: {msg}")
+            raise MemoryGuardExceeded(f"strict_memory: {msg}")
         logger.warning(msg)
 
     # ------------------------------------------------------------------ #

@@ -10,6 +10,8 @@ import os
 import pickle
 from abc import ABC, abstractmethod
 
+from deepspeed_tpu.runtime.fault import inject
+from deepspeed_tpu.runtime.fault.atomic import atomic_write_bytes
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -54,6 +56,10 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         self._ocp = ocp
         self.use_async = use_async
         self._ckptr = None
+        # async mode: (path, pickled meta) pairs whose durability is
+        # deferred to commit() — metadata must never land before the
+        # array shards it describes (see save())
+        self._pending_meta = []
 
     def _checkpointer(self):
         if self._ckptr is None:
@@ -67,9 +73,20 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             ckptr.save(os.path.join(path, "arrays"), arrays, force=True)
             if not self.use_async:
                 ckptr.wait_until_finished()
+        inject.fire("ckpt.arrays_write", path=path)
         os.makedirs(path, exist_ok=True)
-        with open(os.path.join(path, "meta.pkl"), "wb") as f:
-            pickle.dump(meta, f)
+        meta_bytes = pickle.dumps(meta)
+        if self.use_async and arrays is not None:
+            # async-save ordering: the array shards are NOT yet durable
+            # here.  Writing meta.pkl now would let a crash between the
+            # two leave a metadata-complete but data-incomplete
+            # checkpoint — durability is established only at commit(),
+            # after wait_until_finished()
+            self._pending_meta.append((path, meta_bytes))
+            return
+        # temp-file + os.replace: a crash mid-write must never leave a
+        # truncated meta.pkl shadowing the real one
+        atomic_write_bytes(os.path.join(path, "meta.pkl"), meta_bytes)
 
     def load(self, path, abstract_arrays=None):
         path = os.path.abspath(path)
@@ -101,6 +118,17 @@ class OrbaxCheckpointEngine(CheckpointEngine):
     def commit(self, tag):
         if self._ckptr is not None:
             self._ckptr.wait_until_finished()
+        # arrays are durable now — publish the deferred metadata (async
+        # mode; empty list in sync mode).  Entries whose staging dir has
+        # vanished belong to an earlier save that aborted and was GC'd:
+        # drop them with a warning rather than failing THIS commit
+        pending, self._pending_meta = self._pending_meta, []
+        for path, meta_bytes in pending:
+            if not os.path.isdir(path):
+                logger.warning(f"[ckpt] dropping deferred metadata for "
+                               f"vanished save at {path} (aborted save?)")
+                continue
+            atomic_write_bytes(os.path.join(path, "meta.pkl"), meta_bytes)
         logger.info(f"[ckpt] checkpoint tag {tag} committed")
         return True
 

@@ -18,6 +18,7 @@ from pydantic import Field
 
 from deepspeed_tpu.runtime.compile_cache import CompileCacheConfig
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+from deepspeed_tpu.runtime.fault.config import FaultConfig
 from deepspeed_tpu.runtime import constants as C
 from deepspeed_tpu.utils.logging import logger
 
@@ -350,6 +351,7 @@ class DeepSpeedConfig:
         self.autotuning_config = AutotuningConfig(**pd.get(C.AUTOTUNING, {}))
         self.nebula_config = NebulaConfig(**pd.get("nebula", {}))
         self.compile_cache = CompileCacheConfig(**pd.get("compile_cache", {}))
+        self.fault = FaultConfig(**pd.get("fault", {}))
 
         self.gradient_clipping = pd.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
         self.prescale_gradients = pd.get(C.PRESCALE_GRADIENTS, False)

@@ -1241,21 +1241,18 @@ class DeepSpeedEngine:
     # Checkpointing (reference engine.py:2841 save_checkpoint /
     # :2536 load_checkpoint)
     # ------------------------------------------------------------------ #
-    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
-                        exclude_frozen_parameters=False):
-        if tag is None:
-            tag = f"global_step{self.global_steps}"
-        ckpt_dir = os.path.join(save_dir, str(tag))
-        os.makedirs(ckpt_dir, exist_ok=True)
-        self.checkpoint_engine.create(tag)
-        arrays = {
+    def _fault_config(self):
+        fcfg = getattr(self._config, "fault", None)
+        return fcfg if (fcfg is not None and fcfg.enabled) else None
+
+    def _checkpoint_arrays(self):
+        return {
             "module": self._params,
             "optimizer": self._opt_state,
             "loss_scaler": self._scaler_state,
         }
-        if self._host_opt is not None:
-            # streamed per-leaf .npy files — never one giant pickle
-            self._host_opt.save(os.path.join(ckpt_dir, "host_optimizer"))
+
+    def _checkpoint_meta(self, client_state):
         meta = {
             "global_steps": self.global_steps,
             "global_samples": self.global_samples,
@@ -1265,15 +1262,141 @@ class DeepSpeedEngine:
             "ds_config": self._config._param_dict,
             "client_state": client_state or {},
         }
-        self.checkpoint_engine.save(arrays, meta, os.path.join(ckpt_dir, "state"))
+        # the engine RNG key: restoring it is what makes a resumed 3-call
+        # trajectory bitwise-identical to an uninterrupted one (the fused
+        # path folds the step counter in on-device and is already
+        # deterministic given global_steps)
+        try:
+            meta["rng_key_data"] = np.asarray(
+                jax.device_get(jax.random.key_data(self._rng)))
+        except Exception:
+            pass
+        return meta
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
+                        exclude_frozen_parameters=False):
+        if self._params is None:
+            # nothing trained yet (params are lazily initialized by the
+            # first forward) — writing a weightless tag would poison
+            # resume walk-back with an unloadable checkpoint
+            logger.warning("save_checkpoint called before parameters "
+                           "exist; nothing saved")
+            return False
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        fcfg = self._fault_config()
+        if fcfg is not None:
+            return self._save_checkpoint_atomic(save_dir, str(tag),
+                                                client_state, save_latest,
+                                                fcfg)
+        ckpt_dir = os.path.join(save_dir, str(tag))
+        os.makedirs(ckpt_dir, exist_ok=True)
+        self.checkpoint_engine.create(tag)
+        if self._host_opt is not None:
+            # streamed per-leaf .npy files — never one giant pickle
+            self._host_opt.save(os.path.join(ckpt_dir, "host_optimizer"))
+        self.checkpoint_engine.save(self._checkpoint_arrays(),
+                                    self._checkpoint_meta(client_state),
+                                    os.path.join(ckpt_dir, "state"))
         # commit (async engines: wait for durability) BEFORE advancing the
         # 'latest' pointer — a crash mid-save must leave 'latest' on the
         # previous complete checkpoint, never a partial one
         self.checkpoint_engine.commit(tag)
         if save_latest and jax.process_index() == 0:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(str(tag))
+            # temp-file + os.replace: an in-place truncate-then-write
+            # bricked resume when the process died between the two
+            from deepspeed_tpu.runtime.fault.atomic import atomic_write_text
+            atomic_write_text(os.path.join(save_dir, "latest"), str(tag))
         log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
+        return True
+
+    def _save_checkpoint_atomic(self, save_dir, tag, client_state,
+                                save_latest, fcfg):
+        """Crash-atomic checkpoint protocol (``fault.enabled``): stage into
+        ``<tag>.tmp/``, emit ``MANIFEST.json`` (sizes + checksums +
+        fingerprint + step metadata), fsync, atomically rename to
+        ``<tag>/``, atomically swap ``latest``, then GC per retention
+        policy.  A kill at ANY instruction leaves either the previous
+        consistent state or the new one — never a loadable partial.
+        Transient I/O during the write stage retries with backoff."""
+        import shutil
+        import time as _time
+        from deepspeed_tpu.runtime.fault import inject
+        from deepspeed_tpu.runtime.fault.atomic import (atomic_publish_dir,
+                                                        atomic_write_text)
+        from deepspeed_tpu.runtime.fault.manifest import (
+            build_manifest, gc_checkpoints, is_reserved_tag, write_manifest)
+        from deepspeed_tpu.runtime.fault.retry import (
+            retry_call, retry_policy_from_config)
+        if is_reserved_tag(tag):
+            # '<x>.tmp' / '<x>.old.<pid>' are the protocol's staging
+            # namespace — a committed dir with such a name would be
+            # destroyed (or relocated) by the next GC pass
+            raise ValueError(
+                f"checkpoint tag {tag!r} collides with the crash-atomic "
+                "staging namespace ('*.tmp' / '*.old.<pid>'); pick "
+                "another tag")
+        os.makedirs(save_dir, exist_ok=True)
+        final_dir = os.path.join(save_dir, tag)
+        tmp_dir = final_dir + ".tmp"
+        # host-side staging surgery (rmtree, manifest, rename, GC) is
+        # process-0's job on a shared filesystem — every process still
+        # participates in the array save/commit (Orbax coordinates the
+        # sharded write + its own cross-process barrier internally)
+        lead = jax.process_index() == 0
+
+        def write_stage():
+            if lead:
+                if os.path.isdir(tmp_dir):  # stale orphan / failed attempt
+                    shutil.rmtree(tmp_dir)
+                os.makedirs(tmp_dir)
+            inject.fire("ckpt.save_io", path=tmp_dir)
+            self.checkpoint_engine.create(tag)
+            if self._host_opt is not None:
+                self._host_opt.save(os.path.join(tmp_dir, "host_optimizer"))
+            self.checkpoint_engine.save(self._checkpoint_arrays(),
+                                        self._checkpoint_meta(client_state),
+                                        os.path.join(tmp_dir, "state"))
+            # durability barrier for async engines: array shards AND
+            # deferred metadata must be on disk before the manifest walks
+            # the staging dir
+            self.checkpoint_engine.commit(tag)
+
+        retry_call(write_stage, label=f"checkpoint write ({tag})",
+                   **retry_policy_from_config(fcfg))
+        if not lead:
+            return True
+        inject.fire("ckpt.before_manifest", path=tmp_dir)
+        t0 = _time.monotonic()
+        manifest = build_manifest(
+            tmp_dir, tag,
+            step_meta={"global_steps": self.global_steps,
+                       "global_samples": self.global_samples,
+                       "micro_steps": self.micro_steps},
+            checksum=fcfg.checksum, mesh_shape=self.mesh.shape,
+            advance_latest=bool(save_latest))
+        write_manifest(tmp_dir, manifest)
+        verify_secs = _time.monotonic() - t0
+        inject.fire("ckpt.corrupt_shard", path=os.path.join(tmp_dir, "state"))
+        inject.fire("ckpt.before_commit_rename", path=tmp_dir)
+        atomic_publish_dir(tmp_dir, final_dir)
+        inject.fire("ckpt.before_latest_swap", path=save_dir)
+        if save_latest:
+            atomic_write_text(os.path.join(save_dir, "latest"), tag)
+        # retention never deletes this tag NOR whatever 'latest' points to
+        # (they differ under save_latest=False)
+        protect = {tag}
+        latest_path = os.path.join(save_dir, "latest")
+        if os.path.exists(latest_path):
+            with open(latest_path) as f:
+                protect.add(f.read().strip())
+        gc_checkpoints(save_dir, fcfg.keep_last_n, protect=tuple(protect))
+        if self.monitor.enabled:
+            self.monitor.write_events(
+                [("Fault/ckpt_verify_secs", verify_secs, self.global_steps)])
+        log_dist(f"saved checkpoint {tag} to {save_dir} "
+                 f"(manifest {len(manifest['files'])} files, "
+                 f"checksum {verify_secs:.2f}s)", ranks=[0])
         return True
 
     def _metadata_restore_targets(self, md):
@@ -1330,13 +1453,142 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
+        fcfg = self._fault_config()
+        requested = tag
         if tag is None:
             latest = os.path.join(load_dir, "latest")
-            if not os.path.exists(latest):
+            if os.path.exists(latest):
+                with open(latest) as f:
+                    tag = f.read().strip()
+            elif fcfg is None:
                 logger.warning(f"no 'latest' file at {load_dir}; nothing loaded")
                 return None, {}
-            with open(latest) as f:
-                tag = f.read().strip()
+        if fcfg is None:
+            return self._load_checkpoint_tag(
+                load_dir, tag, load_module_strict, load_optimizer_states,
+                load_lr_scheduler_states, load_module_only)
+        # fault-tolerant load: verify the candidate tag's manifest; on a
+        # missing / partial / corrupt tag walk back to the newest valid
+        # one instead of crashing (CheckFreq's verified-restore property)
+        import time as _time
+        from deepspeed_tpu.runtime.fault.manifest import (
+            newest_valid_tag, read_manifest, verify_manifest)
+        if requested is None:
+            # the 'latest' pointer legitimately lags one tag when a crash
+            # lands between the atomic tag rename and the pointer swap —
+            # manifest step ordering is authoritative for resume-eligible
+            # tags (those saved with save_latest=True; side checkpoints
+            # record advance_latest=false and never hijack auto-resume)
+            tag = None
+        tried = []
+        t0 = _time.monotonic()
+        pre_verified = False
+        while True:
+            if tag is None:
+                tag = newest_valid_tag(load_dir,
+                                       checksum_verify=fcfg.verify_on_load,
+                                       skip=tried, for_resume=True)
+                # newest_valid_tag already deep-checksummed this tag —
+                # re-verifying would double the restore's I/O + hashing
+                pre_verified = fcfg.verify_on_load
+            if tag is None:
+                from deepspeed_tpu.runtime.fault.manifest import list_tags
+                remaining = [t for t in list_tags(load_dir)
+                             if t not in tried]
+                # tags that SHOULD have been resume candidates but were
+                # rejected (invalid) — distinct from side checkpoints
+                # (advance_latest=false), which are not failures
+                eligible = [t for t in remaining
+                            if (read_manifest(os.path.join(load_dir, t))
+                                or {}).get("advance_latest") is not False]
+                if tried or eligible:
+                    raise RuntimeError(
+                        f"no valid checkpoint in {load_dir}: every "
+                        "resume-eligible tag failed verification or load "
+                        f"(tried={tried or eligible})")
+                if remaining:
+                    logger.warning(
+                        f"{load_dir} holds only side checkpoints "
+                        f"(save_latest=False: {remaining}); nothing "
+                        "loaded — starting fresh")
+                else:
+                    logger.warning(f"no checkpoint found at {load_dir}; "
+                                   "nothing loaded")
+                return None, {}
+            ckpt_dir = os.path.join(load_dir, str(tag))
+            if fcfg.verify_on_load and not pre_verified \
+                    and read_manifest(ckpt_dir) is not None:
+                problems = verify_manifest(ckpt_dir, deep=True)
+                if problems:
+                    if requested is not None:
+                        # an EXPLICITLY requested tag that fails must fail
+                        # loudly — silently substituting older weights
+                        # would poison evals/exports; auto-resume
+                        # (tag=None) is where walk-back applies
+                        from deepspeed_tpu.runtime.fault.manifest import \
+                            CheckpointCorrupt
+                        raise CheckpointCorrupt(
+                            f"requested checkpoint {tag!r} in {load_dir} "
+                            f"failed verification: {problems[:5]}")
+                    logger.warning(
+                        f"[fault] checkpoint {tag} failed verification "
+                        f"({problems[:3]}{'...' if len(problems) > 3 else ''})"
+                        " — walking back to the previous valid tag")
+                    tried.append(str(tag))
+                    tag = None
+                    continue
+            try:
+                # a transient I/O error (NFS EIO/ESTALE mid-restore) must
+                # NOT be conflated with a corrupt tag: retry the SAME tag
+                # with backoff first — walking back on a flake would
+                # silently discard committed steps
+                from deepspeed_tpu.runtime.fault.retry import (
+                    retry_call, retry_policy_from_config)
+                result = retry_call(
+                    self._load_checkpoint_tag, load_dir, tag,
+                    load_module_strict, load_optimizer_states,
+                    load_lr_scheduler_states, load_module_only,
+                    label=f"checkpoint load ({tag})",
+                    **retry_policy_from_config(fcfg))
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                if requested is not None:
+                    # same loud-failure contract for load errors on an
+                    # explicitly requested tag
+                    raise
+                logger.warning(f"[fault] tag {tag} failed to load "
+                               f"({type(e).__name__}: {e}); walking back")
+                tried.append(str(tag))
+                tag = None
+                continue
+            if requested is None:
+                latest_hint = None
+                latest_path = os.path.join(load_dir, "latest")
+                if os.path.exists(latest_path):
+                    with open(latest_path) as f:
+                        latest_hint = f.read().strip()
+                if latest_hint and latest_hint != str(tag):
+                    # newest-eligible-valid wins over the pointer (the
+                    # crash window leaves 'latest' lagging) — but say so
+                    # loudly: an operator who HAND-EDITED 'latest' to
+                    # roll back must instead load an explicit tag or GC
+                    # the newer tags (docs/fault_tolerance.md)
+                    logger.warning(
+                        f"[fault] resuming from {tag} although 'latest' "
+                        f"points at {latest_hint} (newest valid "
+                        "resume-eligible tag wins; for a manual rollback "
+                        "load an explicit tag or remove the newer tags)")
+            if self.monitor.enabled:
+                self.monitor.write_events(
+                    [("Fault/ckpt_verify_secs", _time.monotonic() - t0,
+                      self.global_steps)])
+            return result
+
+    def _load_checkpoint_tag(self, load_dir, tag, load_module_strict=True,
+                             load_optimizer_states=True,
+                             load_lr_scheduler_states=True,
+                             load_module_only=False):
         path = os.path.join(load_dir, str(tag), "state")
         abstract = None
         if self._params is not None:
@@ -1358,6 +1610,20 @@ class DeepSpeedEngine:
                 abstract = self._metadata_restore_targets(md)
         fresh_engine = self._params is None
         arrays, meta = self.checkpoint_engine.load(path, abstract_arrays=abstract)
+        if arrays is None or not isinstance(arrays, dict) \
+                or arrays.get("module") is None:
+            # missing/partial 'arrays' dir: the seed indexed
+            # arrays["module"] with arrays=None and died on a TypeError —
+            # surface what actually happened (fault-enabled loads catch
+            # this and walk back to the previous tag).  Deliberately NOT
+            # an OSError: the retry policy treats those as transient, and
+            # this condition is permanent
+            from deepspeed_tpu.runtime.fault.manifest import \
+                CheckpointCorrupt
+            raise CheckpointCorrupt(
+                f"checkpoint {tag!r} at {path} has no loadable 'arrays' "
+                "payload (partial or corrupt save?) — cannot restore "
+                "module weights")
         self._params = arrays["module"]
         if load_module_only:
             if fresh_engine and self._host_opt is None:
@@ -1394,6 +1660,14 @@ class DeepSpeedEngine:
         self.global_samples = meta.get("global_samples", 0)
         self.micro_steps = meta.get("micro_steps", 0)
         self.skipped_steps = meta.get("skipped_steps", 0)
+        if meta.get("rng_key_data") is not None:
+            # restore the engine RNG stream: resumed runs draw the same
+            # dropout/init keys an uninterrupted run would have drawn
+            try:
+                self._rng = jax.random.wrap_key_data(
+                    jnp.asarray(meta["rng_key_data"]))
+            except Exception as e:
+                logger.warning(f"could not restore engine RNG key: {e}")
         if load_lr_scheduler_states and self.lr_scheduler and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
         if fresh_engine and self._host_opt is None:

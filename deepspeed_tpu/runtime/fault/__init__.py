@@ -1,0 +1,35 @@
+"""Fault-tolerance subsystem — crash-atomic checkpoints, fault injection,
+auto-resume supervision.
+
+The paper's reference stack survives preemption through elastic agents and
+tiered (Nebula) checkpointing; on preemptible TPU slices recovery is the
+difference between a production system and a demo (CheckFreq, FAST '21;
+Gemini, SOSP '23).  This package provides the pieces and the proof:
+
+* :mod:`~deepspeed_tpu.runtime.fault.manifest` — the crash-atomic
+  checkpoint protocol: write into ``<tag>.tmp/``, emit a ``MANIFEST.json``
+  (per-file sizes + checksums, jax/topology fingerprint, step metadata),
+  fsync, atomically rename to ``<tag>/``, atomically swap ``latest``.
+* :mod:`~deepspeed_tpu.runtime.fault.inject` — named deterministic fault
+  injection points so tests can kill the run at every seam.
+* :mod:`~deepspeed_tpu.runtime.fault.retry` — bounded retry with
+  exponential backoff + jitter for transient I/O.
+* :mod:`~deepspeed_tpu.runtime.fault.supervisor` — ``run_resilient``:
+  heartbeat watchdog, reload-latest-valid-then-continue, elastic config
+  recompute, integrated with ``DSElasticAgent``.
+
+All knobs live in the ``fault`` config block (:class:`FaultConfig`),
+default off = seed behavior.  See ``docs/fault_tolerance.md``.
+"""
+
+from deepspeed_tpu.runtime.fault.config import FaultConfig  # noqa: F401
+from deepspeed_tpu.runtime.fault.inject import (  # noqa: F401
+    InjectedFault, fire, configure_injection, reset_injection,
+    injection_points)
+from deepspeed_tpu.runtime.fault.manifest import (  # noqa: F401
+    MANIFEST_NAME, CheckpointCorrupt, build_manifest, write_manifest,
+    verify_manifest, read_manifest, list_tags, newest_valid_tag,
+    gc_checkpoints)
+from deepspeed_tpu.runtime.fault.retry import retry_call, TRANSIENT_IO_ERRORS  # noqa: F401
+from deepspeed_tpu.runtime.fault.supervisor import (  # noqa: F401
+    run_resilient, StepHangError, elastic_resume_config)

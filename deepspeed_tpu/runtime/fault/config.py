@@ -1,0 +1,58 @@
+"""``fault`` config block — every fault-tolerance knob in one model.
+
+Shared by the training config (``runtime/config.py``) and the inference
+config (``inference/config.py``); ``enabled: false`` (the default) keeps
+exact seed behavior everywhere.  See ``docs/fault_tolerance.md`` and the
+``fault`` section of ``docs/config-json.md``.
+"""
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class FaultConfig(DeepSpeedConfigModel):
+    # master switch: off = seed behavior (no manifest protocol, no retries,
+    # no verification; the atomicity BUG fixes — temp-file 'latest' and
+    # meta.pkl writes — are unconditional, they change no semantics)
+    enabled: bool = False
+
+    # ---- crash-atomic checkpoint protocol ---------------------------- #
+    # verify MANIFEST.json (sizes + checksums) before trusting a tag on
+    # load; a failed tag is skipped and load walks back to the newest
+    # valid one
+    verify_on_load: bool = True
+    # per-file checksum algorithm recorded in the manifest:
+    # "sha256" (cryptographic) or "crc32" (fast, bit-rot-grade)
+    checksum: str = "sha256"
+    # retention: keep the newest N valid tags, GC older ones and orphaned
+    # <tag>.tmp dirs after every successful save; 0 = keep everything
+    keep_last_n: int = 0
+
+    # ---- transient-failure retry policy ------------------------------ #
+    # bounded retries with exponential backoff + jitter for transient
+    # I/O during save and executable load during inference
+    max_retries: int = 3
+    backoff_base_secs: float = 0.5
+    backoff_max_secs: float = 30.0
+    # fraction of the backoff added as deterministic jitter (decorrelates
+    # herds of preempted workers re-reading the same store)
+    backoff_jitter: float = 0.25
+
+    # ---- auto-resume supervisor (run_resilient) ---------------------- #
+    # give up after this many reload-and-continue recoveries; the
+    # supervisor returns ("failed", steps) instead of looping forever
+    max_resumes: int = 10
+    # heartbeat watchdog: a step taking longer than this dumps all thread
+    # stacks and (emergency_checkpoint_on_hang) saves before recovering;
+    # 0 = watchdog off
+    heartbeat_timeout_secs: float = 0.0
+    emergency_checkpoint_on_hang: bool = True
+    # steps between periodic supervisor checkpoints; 0 = only emergency /
+    # final checkpoints
+    save_interval: int = 0
+
+    # ---- inference graceful degradation ------------------------------ #
+    # under strict_memory, a generation program over the memory guard
+    # splits the batch in half (recursively, down to batch 1) and runs
+    # the halves sequentially instead of raising — documented
+    # bucket-downshift fallback (docs/fault_tolerance.md)
+    bucket_downshift: bool = False

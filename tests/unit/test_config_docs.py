@@ -17,6 +17,7 @@ DOC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # top-level JSON key -> config model
 def _blocks():
     from deepspeed_tpu.runtime import config as rc
+    from deepspeed_tpu.runtime.fault.config import FaultConfig
     from deepspeed_tpu.inference.config import (DeepSpeedInferenceConfig,
                                                 QuantizationConfig)
     return {
@@ -47,8 +48,10 @@ def _blocks():
         "autotuning": rc.AutotuningConfig,
         "nebula": rc.NebulaConfig,
         "compile_cache": rc.CompileCacheConfig,
+        "fault": FaultConfig,
         "init_inference": DeepSpeedInferenceConfig,
         "init_inference.quant": QuantizationConfig,
+        "init_inference.fault": FaultConfig,
     }
 
 

@@ -58,3 +58,84 @@ def test_v02_node_granularity():
                           "num_gpus_per_node": 4}}
     batch, valid = compute_elastic_config(cfg)
     assert all(w % 4 == 0 for w in valid)
+
+
+def test_sigterm_emergency_checkpoint_and_cross_world_resume(tmp_path):
+    """DSElasticAgent end-to-end: SIGTERM mid-run -> emergency checkpoint
+    at the step boundary -> resume into a DIFFERENT world size via
+    ``elastic_config_for``, preserving the global batch (the reference's
+    v0.1/v0.2 schedulers' invariant)."""
+    import os
+    import signal
+
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    from deepspeed_tpu.parallel.topology import reset_topology
+    from simple_model import SimpleModel, random_batch
+
+    elastic = {"enabled": True, "max_train_batch_size": 64,
+               "micro_batch_sizes": [2, 4], "min_gpus": 1, "max_gpus": 64,
+               "version": 0.1}
+    base = {"elasticity": elastic,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 1},
+            "fault": {"enabled": True, "checksum": "crc32"}}
+
+    agent = DSElasticAgent(base, checkpoint_dir=str(tmp_path), world_size=8)
+    cfg8 = agent.elastic_config_for(8)
+    gbs = cfg8["train_batch_size"]
+    assert cfg8["train_micro_batch_size_per_gpu"] * \
+        cfg8["gradient_accumulation_steps"] * 8 == gbs
+
+    engine, *_ = deepspeed_tpu.initialize(model=SimpleModel(hidden_dim=16),
+                                          config=cfg8)
+    assert engine.train_batch_size() == gbs
+    step_count = [0]
+
+    def step_fn():
+        for _ in range(engine.gradient_accumulation_steps()):
+            loss = engine(random_batch(batch_size=32,
+                                       seed=engine.global_steps))
+            engine.backward(loss)
+        engine.step()
+        step_count[0] += 1
+        if step_count[0] == 2:        # preemption arrives mid-run
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    status, steps = agent.run(step_fn, engine, max_steps=10)
+    assert status == "preempted" and steps == 2
+    assert engine.global_steps == 2
+    from deepspeed_tpu.runtime.fault.manifest import (list_tags,
+                                                      verify_manifest)
+    tags = list_tags(str(tmp_path))
+    assert any(t.startswith("preempt_") for t in tags), tags
+    assert verify_manifest(str(tmp_path / tags[0])) == []
+    w_ref = np.asarray(jax.tree.leaves(engine.params)[0], np.float32)
+
+    # resume on a HALVED slice: tp=2 over the same 8 devices -> dp world 4
+    cfg4 = agent.elastic_config_for(4)
+    assert cfg4["train_batch_size"] == gbs, \
+        "elastic resume must preserve the global batch"
+    assert cfg4["train_micro_batch_size_per_gpu"] * \
+        cfg4["gradient_accumulation_steps"] * 4 == gbs
+    cfg4["tensor_parallel"] = {"tp_size": 2}
+    reset_topology()
+    engine2, *_ = deepspeed_tpu.initialize(model=SimpleModel(hidden_dim=16),
+                                           config=cfg4)
+    engine2.load_checkpoint(str(tmp_path))
+    assert engine2.global_steps == 2
+    assert engine2.train_batch_size() == gbs
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(engine2.params)[0], np.float32), w_ref)
+    # training continues at the new world size
+    for _ in range(engine2.gradient_accumulation_steps()):
+        loss = engine2(random_batch(
+            batch_size=cfg4["train_micro_batch_size_per_gpu"] * 4,
+            seed=engine2.global_steps))
+        engine2.backward(loss)
+    engine2.step()
+    assert engine2.global_steps == 3
+    assert np.isfinite(float(jax.device_get(loss)))

@@ -419,6 +419,99 @@ def test_serving_memory_guardrail(model_and_params, monkeypatch, caplog):
     assert ok.generate(ids, max_new_tokens=4).shape == (2, 16)
 
 
+def test_strict_memory_bucket_downshift(model_and_params):
+    """Graceful degradation (fault.bucket_downshift): a generation batch
+    refused by the strict_memory guard is served as two sequential
+    half-batches instead of failing the request; greedy tokens must match
+    an unconstrained engine's row for row."""
+    from deepspeed_tpu.inference import engine as eng_mod
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    model, params, ids = model_and_params
+    ref = InferenceEngine(model,
+                          DeepSpeedInferenceConfig(dtype="float32"),
+                          params=params)
+    want = np.asarray(ref.generate(ids, max_new_tokens=4))
+
+    eng = InferenceEngine(
+        model,
+        DeepSpeedInferenceConfig(dtype="float32", strict_memory=True,
+                                 fault={"enabled": True,
+                                        "bucket_downshift": True}),
+        params=params)
+    # deterministic batch-aware refusal: the first compiled program (the
+    # full batch-2 bucket) is over budget, the downshifted batch-1
+    # programs pass — the real byte-threshold path is covered by
+    # test_serving_memory_guardrail
+    refused = []
+
+    def guard_once(compiled):
+        if not refused:
+            refused.append(True)
+            raise eng_mod.MemoryGuardExceeded("strict_memory: test bucket")
+    eng._guard_memory = guard_once
+    out = eng.generate(ids, max_new_tokens=4)
+    assert eng.fault_stats["bucket_downshifts"] == 1
+    np.testing.assert_array_equal(np.asarray(out), want)
+
+    # without the fault block the refusal stays a hard error (seed
+    # behavior)
+    strict = InferenceEngine(
+        model, DeepSpeedInferenceConfig(dtype="float32",
+                                        strict_memory=True),
+        params=params)
+    refused.clear()
+    strict._guard_memory = guard_once
+    with pytest.raises(RuntimeError, match="strict_memory"):
+        strict.generate(ids, max_new_tokens=4)
+
+
+def test_transient_executable_load_retries(model_and_params):
+    """fault.max_retries bounds retry/backoff around transient executable
+    load failures; exhaustion degrades to the plain jit path instead of
+    failing generation."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.runtime.fault import inject
+    model, params, ids = model_and_params
+    inject.reset_injection()
+    try:
+        eng = InferenceEngine(
+            model,
+            DeepSpeedInferenceConfig(
+                dtype="float32",
+                fault={"enabled": True, "max_retries": 3,
+                       "backoff_base_secs": 0.01,
+                       "backoff_max_secs": 0.05}),
+            params=params)
+        specs = inject.configure_injection(
+            {"point": "infer.executable_load", "action": "raise",
+             "times": 2})
+        out = eng.generate(ids, max_new_tokens=4)
+        assert out.shape == (2, 16)
+        assert specs[0].fired == 2
+        assert eng.fault_stats["exec_load_retries"] == 2
+        inject.reset_injection()
+
+        # exhaustion: every attempt fails -> plain-jit degradation, the
+        # request still completes
+        eng2 = InferenceEngine(
+            model,
+            DeepSpeedInferenceConfig(
+                dtype="float32",
+                fault={"enabled": True, "max_retries": 1,
+                       "backoff_base_secs": 0.01,
+                       "backoff_max_secs": 0.02}),
+            params=params)
+        inject.configure_injection(
+            {"point": "infer.executable_load", "action": "raise",
+             "times": 0})
+        out = eng2.generate(ids, max_new_tokens=4)
+        assert out.shape == (2, 16)
+    finally:
+        inject.reset_injection()
+
+
 def test_kv_workspace_reuse_and_release(model_and_params):
     """The engine-owned KV workspace is donated and reused across calls
     (same shape -> same buffer lineage), reallocated on shape change, and

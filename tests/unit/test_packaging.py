@@ -26,7 +26,7 @@ def test_pip_install_console_scripts(tmp_path):
     bindir = prefix / "bin"
     installed = {os.path.basename(p) for p in glob.glob(str(bindir / "*"))}
     for script in ("deepspeed", "ds", "dsr", "deepspeed.pt", "ds_report",
-                   "ds_bench", "ds_elastic", "ds_ssh"):
+                   "ds_bench", "ds_elastic", "ds_ssh", "ds_ckpt"):
         assert script in installed, f"{script} missing from {installed}"
 
     site = glob.glob(str(prefix / "lib" / "python*" / "site-packages"))

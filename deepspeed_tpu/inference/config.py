@@ -7,6 +7,7 @@ from typing import Any, Dict, Optional
 
 from pydantic import Field
 
+from deepspeed_tpu.inference.serving.config import ServingConfig
 from deepspeed_tpu.runtime.compile_cache import CompileCacheConfig
 from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
 from deepspeed_tpu.runtime.fault.config import FaultConfig
@@ -90,6 +91,15 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # executable-load failures; ``enabled`` + ``bucket_downshift`` turns
     # a strict_memory guard refusal into a batch split (see generate())
     fault: FaultConfig = Field(default_factory=FaultConfig)
+    # continuous-batching serving (inference/serving/, docs/serving.md):
+    # slot-based in-flight batching behind ``engine.serve()`` — default
+    # off = current whole-batch generate() behavior
+    serving: ServingConfig = Field(default_factory=ServingConfig)
+    # decode loop form: True (default) runs the generation decode loop as
+    # a bounded lax.while_loop that stops once every row hit EOS (short
+    # completions skip the masked tail steps); False keeps the fixed-
+    # length lax.scan.  Tokens are bitwise-identical either way.
+    decode_early_exit: bool = True
 
     def model_post_init(self, _ctx):
         if self.mp_size is not None and self.tensor_parallel.tp_size == 1:

@@ -254,8 +254,14 @@ class InferenceEngine:
     def _get_generate(self, prompt_len, max_new_tokens, do_sample, temperature,
                       top_k, top_p, with_mask=False, prefill_chunk=None,
                       external_prefill=False):
+        # the loop form (early-exit while vs scan) rides the key: it is
+        # part of the compiled program's identity, and the executable
+        # STORE key derives from this tuple — without it a warm cache
+        # would silently reload the other form and decode_early_exit
+        # would be a no-op exactly on warm starts
         key = ("gen", prompt_len, max_new_tokens, do_sample, temperature,
-               top_k, top_p, with_mask, prefill_chunk, external_prefill)
+               top_k, top_p, with_mask, prefill_chunk, external_prefill,
+               self._config.decode_early_exit)
         if key in self._compiled:
             return self._compiled[key]
         # carry the quantized tree through the scan only when its dequant
@@ -267,7 +273,8 @@ class InferenceEngine:
             param_transform=self._deq, with_mask=with_mask,
             carry_params=self._quantizer is not None
             and self._quantizer.materializing_dequant,
-            prefill_chunk=prefill_chunk, external_prefill=external_prefill)
+            prefill_chunk=prefill_chunk, external_prefill=external_prefill,
+            early_exit=self._config.decode_early_exit)
         self._tags[id(self._compiled[key])] = key
         return self._compiled[key]
 
@@ -447,6 +454,17 @@ class InferenceEngine:
         ``release_workspace``, ``inference_context.h``)."""
         self._workspace.release()
 
+    def serve(self, monitor=None, **overrides):
+        """A continuous-batching :class:`~deepspeed_tpu.inference.serving.
+        ServingEngine` over this engine (``docs/serving.md``): slot-based
+        in-flight batching — ``submit()`` requests, ``drain()`` results;
+        new requests join freed KV slots between decode iterations instead
+        of waiting for a whole ``generate()`` batch to finish.  Knobs come
+        from the ``serving`` config block, overridable per call
+        (``engine.serve(num_slots=16)``)."""
+        from deepspeed_tpu.inference.serving.engine import ServingEngine
+        return ServingEngine(self, monitor=monitor, **overrides)
+
     def _run_guarded(self, fn, args):
         """Compile-and-check-then-execute: the generation program is
         AOT-compiled ONCE per argument signature (same executable the jit
@@ -469,7 +487,7 @@ class InferenceEngine:
             raise MemoryGuardExceeded(
                 f"strict_memory: generation program for this signature was "
                 f"previously refused by the memory guard (batch "
-                f"{args[2].shape[0] if hasattr(args[2], 'shape') else '?'})")
+                f"{args[2].shape[0] if len(args) > 2 and hasattr(args[2], 'shape') else '?'})")
         compiled = self._aot.get(sig)
         if compiled is None:
             try:
@@ -790,11 +808,39 @@ def required_cache_len(prompt_len, max_new_tokens, prefill_chunk):
     return -(-base // 8) * 8
 
 
+def build_sample_fn(do_sample, temperature, top_k, top_p):
+    """The one sampling rule every decode path shares (whole-batch
+    generation, hybrid rollouts, the serving decode step): greedy argmax,
+    or temperature / top-k / top-p sampling over fp32 logits.  Shared so
+    the serving engine's per-slot decode samples BITWISE like
+    ``generate()`` does — the scheduler-correctness contract."""
+
+    def sample_fn(logits, rng):
+        logits = logits.astype(jnp.float32)
+        if not do_sample:
+            return jnp.argmax(logits, axis=-1)
+        if temperature != 1.0:
+            logits = logits / jnp.maximum(temperature, 1e-6)
+        if top_k > 0:
+            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        if 0.0 < top_p < 1.0:
+            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+            cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+            logits = jnp.where(logits < cutoff, -1e30, logits)
+        return jax.random.categorical(rng, logits, axis=-1)
+
+    return sample_fn
+
+
 def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
                      do_sample, temperature, top_k, top_p,
                      param_transform=None, with_mask=False,
                      carry_params=None, prefill_chunk=None,
-                     external_prefill=False):
+                     external_prefill=False, early_exit=True):
     """Build the jitted generation program: one-pass prefill + lax.scan
     decode loop with greedy / temperature / top-k / top-p sampling.  Shared
     by ``InferenceEngine`` and ``DeepSpeedHybridEngine``.
@@ -819,25 +865,17 @@ def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
     positions long (chunked prefill writes the padded prompt tail).
     ``external_prefill=True`` builds the decode-only program: the caller
     prefilled the cache already (engine split-prefill path) and passes the
-    last-position ``prefill_logits`` [B, 1, V]."""
+    last-position ``prefill_logits`` [B, 1, V].
 
-    def sample_fn(logits, rng):
-        logits = logits.astype(jnp.float32)
-        if not do_sample:
-            return jnp.argmax(logits, axis=-1)
-        if temperature != 1.0:
-            logits = logits / jnp.maximum(temperature, 1e-6)
-        if top_k > 0:
-            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
-            logits = jnp.where(logits < kth, -1e30, logits)
-        if 0.0 < top_p < 1.0:
-            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-            probs = jax.nn.softmax(sorted_logits, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
-            cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
-            logits = jnp.where(logits < cutoff, -1e30, logits)
-        return jax.random.categorical(rng, logits, axis=-1)
+    ``early_exit=True`` (default) hoists the decode scan into a BOUNDED
+    ``lax.while_loop`` that stops once every row is ``done`` — short
+    completions no longer pay ``max_new_tokens`` masked decode steps.
+    Tokens are bitwise-identical either way (post-done steps emit
+    ``eos_id`` in both forms; the output buffer is eos-prefilled), only
+    the number of executed decode steps differs.  ``early_exit=False``
+    keeps the scan form (``decode_early_exit`` in the inference config)."""
+
+    sample_fn = build_sample_fn(do_sample, temperature, top_k, top_p)
 
     if carry_params is None:
         carry_params = param_transform is not None
@@ -921,6 +959,34 @@ def make_generate_fn(module, compute_dtype, prompt_len, max_new_tokens,
             return (nxt, cache, pos + 1, rng, done, qparams), nxt
 
         done0 = (next_tok == eos_id)
+        T = max_new_tokens - 1
+        if early_exit and T > 0:
+            # bounded while_loop in place of the scan: stops the moment
+            # every row is done, so a batch of short completions pays only
+            # the steps it actually decodes.  Post-done steps emit eos_id
+            # (same as the scan form) and the output buffer is prefilled
+            # with eos_id, so tokens are bitwise-identical to the scan.
+            buf0 = jnp.full((B, T), eos_id).astype(jnp.int32)
+
+            def cond(carry):
+                t, _, _, _, _, done, _, _ = carry
+                return (t < T) & jnp.logical_not(jnp.all(done))
+
+            def body(carry):
+                t, tok, cache, pos, rng, done, qparams, buf = carry
+                (tok, cache, pos, rng, done, qparams), nxt = step(
+                    (tok, cache, pos, rng, done, qparams), None)
+                buf = jax.lax.dynamic_update_slice(
+                    buf, nxt.astype(jnp.int32)[:, None], (0, t))
+                return (t + 1, tok, cache, pos, rng, done, qparams, buf)
+
+            init = (jnp.asarray(0, jnp.int32), next_tok, cache, pos0, rng,
+                    done0, params if carry_params else 0, buf0)
+            _, _, cache, _, _, _, _, toks_bt = jax.lax.while_loop(
+                cond, body, init)
+            out = jnp.concatenate(
+                [input_ids, next_tok[:, None], toks_bt], axis=1)
+            return out, cache
         (_, cache, _, _, _, _), toks = jax.lax.scan(
             step, (next_tok, cache, pos0, rng, done0,
                    params if carry_params else 0),

@@ -1,0 +1,22 @@
+"""Continuous-batching serving (``docs/serving.md``): slot-based in-flight
+batching over the inference engine — a request queue, fixed-shape KV slot
+lanes, admission prefill through the donated per-chunk executable, and ONE
+reusable decode-step program that advances every live slot per iteration
+(slot occupancy rides traced arguments, so admissions and EOS retirements
+never recompile anything).
+
+``ServingEngine`` is imported lazily: ``inference/config.py`` embeds
+:class:`ServingConfig`, and an eager import here would cycle back through
+``inference/engine.py``.
+"""
+
+from deepspeed_tpu.inference.serving.config import ServingConfig
+
+__all__ = ["ServingConfig", "ServingEngine", "ServeRequest"]
+
+
+def __getattr__(name):
+    if name in ("ServingEngine", "ServeRequest"):
+        from deepspeed_tpu.inference.serving import engine as _engine
+        return getattr(_engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,46 @@
+"""``serving`` config block — continuous-batching serving engine knobs
+(``docs/serving.md``).  Kept import-light: ``inference/config.py`` embeds
+this model, and the serving engine itself is imported lazily."""
+
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigModel
+
+
+class ServingConfig(DeepSpeedConfigModel):
+    """Knobs for :class:`deepspeed_tpu.inference.serving.ServingEngine`
+    (``engine.serve()``).  Default off = current behavior: nothing in the
+    whole-batch ``generate()`` path changes unless ``serve()`` is called
+    (the explicit opt-in); ``enabled`` documents the deployment intent in
+    ops configs.  ``ServingEngine.warmup()`` precompiles the serving
+    programs."""
+    enabled: bool = False
+    # fixed-shape KV slot lanes: the ONE decode-step program is compiled
+    # for exactly this many cache rows; requests map onto freed lanes
+    num_slots: int = 8
+    # per-slot cache positions (rounded up to a multiple of 8 — the fused
+    # decode kernel's sublane alignment); every request must satisfy
+    # ceil(prompt/chunk)*chunk <= max_cache_len and
+    # prompt + max_new_tokens <= max_cache_len
+    max_cache_len: int = 2048
+    # admission-prefill chunk: prompts stream through the engine's donated
+    # per-chunk executable in blocks of this many tokens (aligned to a
+    # multiple of 8, floor 8, cap 512 like prefill_chunk_size)
+    prefill_chunk: int = 128
+    # prefill tokens spent per scheduler iteration before decode resumes
+    # (the Sarathi/Orca-style interleave bound); 0 = finish each admission's
+    # prefill in one iteration
+    prefill_token_budget: int = 512
+    # decode steps per host round trip: one compiled program advances all
+    # slots `decode_block` tokens between scheduling points.  Larger blocks
+    # amortize dispatch latency; retired slots idle for at most
+    # decode_block-1 steps before the scheduler reclaims them
+    decode_block: int = 4
+    # admission order: "fcfs" (arrival) | "shortest_first" (shortest
+    # prompt first — lowers mean time-to-first-token under backlog)
+    admission: str = "fcfs"
+    # sampling applied to every request (greedy when do_sample=False);
+    # per-request eos_token_id/max_new_tokens ride the slot state instead
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0

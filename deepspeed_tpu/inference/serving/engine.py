@@ -1,0 +1,585 @@
+"""Continuous-batching serving engine — iteration-level scheduling over
+``InferenceEngine`` (Orca, Yu et al. OSDI'22; slot/paged KV management in
+the spirit of vLLM's PagedAttention, Kwon et al. SOSP'23 — here with the
+TPU constraint that every program keeps FIXED shapes).
+
+The scheduler loop per iteration (:meth:`ServingEngine.step`):
+
+1. **Admission** — while a KV slot is free and the queue is non-empty,
+   pop a request (``fcfs`` or ``shortest_first``) and stream its prompt
+   through the engine's donated per-chunk prefill executable
+   (``_get_chunk_fn(C, 1)`` — the same program the split-prefill
+   ``generate()`` path replays) into a single-lane cache, spending at most
+   ``prefill_token_budget`` prompt tokens per iteration so a long prompt
+   cannot starve decoding.  A finished prefill dispatches ONE fused admit
+   program (first-token sample + lane insert + in-program slot-state
+   write).
+2. **Decode** — ONE call of the single reusable decode-step program
+   advances every live slot ``decode_block`` tokens (cache + slot state
+   donated).  Rows that emit their ``eos`` (or exhaust ``max_new_tokens``)
+   retire IN-PROGRAM; the host mirrors the retirement bookkeeping from the
+   emitted tokens, frees their slots mid-flight, and hands the lanes to
+   the admission queue — no request ever waits for a batch to finish.
+
+**Latency-hiding (the tunneled-device lesson — each separate dispatch
+costs ~0.1 s there):** the slot state lives ON DEVICE and every program
+chains through it by data dependency, so the host never synchronizes
+inside the dispatch path.  Token reads lag ONE event behind: the host
+dispatches the next decode block first and only then materializes the
+previous block's tokens, so the device (and the tunnel) stay busy while
+the host does its scheduling bookkeeping.  The price is that a slot freed
+in block N is re-admittable only from block N+2 — at most one block of
+idle per retirement.
+
+Because slot occupancy rides traced arguments, the whole server lifetime
+compiles exactly ONE decode-step executable per (num_slots, cache_len,
+block, sampling) configuration — persisted through the ``compile_cache``
+block and reloaded (not recompiled) across server restarts.
+"""
+
+import math
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.serving.config import ServingConfig
+from deepspeed_tpu.inference.serving.slots import (init_slot_state,
+                                                   make_admit_fn,
+                                                   make_decode_block_fn)
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+@dataclass
+class ServeRequest:
+    """One queued/running generation request (host bookkeeping only)."""
+    rid: int
+    ids: np.ndarray                  # [P] int32 prompt
+    max_new: int
+    eos: int                         # -1 = never stop early
+    submitted_it: int = 0
+    tokens: list = field(default_factory=list)
+    slot: Optional[int] = None
+    finished_it: Optional[int] = None
+
+
+class _PendingPrefill:
+    """An admission in progress: the slot is reserved, the prompt streams
+    chunk-by-chunk into the lane cache across scheduler iterations."""
+
+    def __init__(self, req, slot, lane, ids_pad, n_chunks):
+        self.req, self.slot, self.lane = req, slot, lane
+        self.ids_pad = ids_pad           # [1, n_chunks*C] int32
+        self.n_chunks = n_chunks
+        self.ci = 0                      # chunks completed
+        self.sel = None                  # last-real-position logits [1,1,V]
+
+
+class _LanePool:
+    """Reusable single-lane prefill caches.  Several admissions can be in
+    flight at once (the admit op that consumes a lane is processed one
+    event behind), so this is a pool, not a single workspace slot — with
+    the same donated-and-dead liveness check ``KVCacheWorkspace`` does."""
+
+    def __init__(self, module):
+        self._module = module
+        self._lanes = []
+
+    def take(self, cache_len, dtype):
+        while self._lanes:
+            lane = self._lanes.pop()
+            if not any(getattr(l, "is_deleted", lambda: False)()
+                       for l in jax.tree.leaves(lane)):
+                return lane
+        return self._module.init_cache(1, cache_len, dtype=dtype)
+
+    def give_back(self, lane):
+        self._lanes.append(lane)
+
+    def release(self):
+        self._lanes.clear()
+
+
+class ServingEngine:
+    """Slot-based continuous batching over an :class:`InferenceEngine`.
+
+    ``submit()`` enqueues a request and returns its id; ``step()`` runs one
+    scheduler iteration; ``drain()`` loops until everything submitted has
+    finished and returns ``{rid: np.ndarray}`` where each output follows
+    the ``generate()`` contract ``[prompt..., generated...]`` of length
+    ``len(prompt) + max_new_tokens`` (eos-padded past early stops — under
+    greedy decoding, bitwise what ``engine.generate()`` returns for the
+    same request solo)."""
+
+    def __init__(self, engine, monitor=None, **overrides):
+        assert engine.params is not None, \
+            "no parameters: set_params/init_params first"
+        cfg = getattr(engine._config, "serving", None) or ServingConfig()
+        if overrides:
+            cfg = ServingConfig(**{**cfg.model_dump(), **overrides})
+        self.engine = engine
+        self.module = engine.module
+        self.config = cfg
+        self.monitor = monitor
+        self.num_slots = int(cfg.num_slots)
+        if self.num_slots < 1:
+            raise ValueError(f"serving.num_slots={cfg.num_slots}: need >= 1")
+        # lane length: multiple of 8 (the fused decode kernel's sublane
+        # alignment — same rounding as required_cache_len)
+        self.cache_len = -(-int(cfg.max_cache_len) // 8) * 8
+        # admission chunk: align like the engine's prefill_chunk_size
+        # (multiple of 8, floor 8, cap 512 — the chunk kernel's bounds)
+        self.chunk = min(512, max(8, -(-int(cfg.prefill_chunk) // 8) * 8))
+        max_seq = getattr(getattr(self.module, "config", None),
+                          "max_seq_len", None)
+        if max_seq is not None and self.cache_len > max_seq:
+            logger.warning(
+                f"serving.max_cache_len={self.cache_len} exceeds the "
+                f"model's max_seq_len={max_seq} — positions past it will "
+                f"fault on learned position embeddings")
+        if cfg.admission not in ("fcfs", "shortest_first"):
+            raise ValueError(f"serving.admission={cfg.admission!r}: "
+                             f"one of 'fcfs', 'shortest_first'")
+        self.block = max(1, int(cfg.decode_block))
+
+        from deepspeed_tpu.inference.engine import (KVCacheWorkspace,
+                                                    build_sample_fn)
+        sample_fn = build_sample_fn(bool(cfg.do_sample),
+                                    float(cfg.temperature),
+                                    int(cfg.top_k), float(cfg.top_p))
+        sampling_key = (bool(cfg.do_sample), float(cfg.temperature),
+                        int(cfg.top_k), float(cfg.top_p))
+        self._decode_fn = make_decode_block_fn(
+            self.module, sample_fn, engine._deq, self.block, self.cache_len)
+        self._admit_fn = make_admit_fn(sample_fn)
+        # stable program tags → the engine's AOT path persists/reloads
+        # these executables through the compile_cache store
+        engine._tags[id(self._decode_fn)] = (
+            "serving_decode", self.num_slots, self.cache_len, self.block,
+            sampling_key)
+        engine._tags[id(self._admit_fn)] = (
+            "serving_admit", self.num_slots, self.cache_len, sampling_key)
+        self._chunk_fn = engine._get_chunk_fn(self.chunk, 1)
+
+        self._cache_ws = KVCacheWorkspace(self.module)
+        self._lane_pool = _LanePool(self.module)
+        self._cache = None
+        self._state = None               # device-resident slot state
+        # host mirror of slot occupancy, updated as events are PROCESSED
+        # (it lags the device by the in-flight events — by design)
+        self._mirror_active = np.zeros((self.num_slots,), bool)
+        self._slots = [None] * self.num_slots      # slot -> ServeRequest
+        self._free = deque(range(self.num_slots))
+        self._queue = deque()
+        self._pending = None
+        # dispatched-but-unprocessed device work, processed FIFO one
+        # event behind the newest dispatch: ("decode", toks_dev) |
+        # ("admit", req, slot, lane, first_dev)
+        self._events = deque()
+        self._rng = jax.random.key(int(cfg.seed))
+        self._next_rid = 0
+        self._it = 0
+        # observability (docs/serving.md): scheduler counters + the
+        # slot-occupancy trace the correctness test asserts EOS-mid-flight
+        # retirement against
+        self.stats = {"iterations": 0, "decode_calls": 0,
+                      "decode_tokens": 0, "prefill_tokens": 0,
+                      "completed": 0, "admitted": 0, "wall_secs": 0.0,
+                      "sync_secs": 0.0}
+        self.occupancy_trace = []                  # (iteration, n_active)
+
+    # ------------------------------------------------------------------ #
+    # Public API
+    # ------------------------------------------------------------------ #
+    def submit(self, input_ids, max_new_tokens=32, eos_token_id=-1):
+        """Enqueue one prompt; returns the request id.  The request must
+        fit a slot lane: ``ceil(P/chunk)*chunk <= max_cache_len`` (chunked
+        prefill writes the padded tail) and ``P + max_new_tokens <=
+        max_cache_len``."""
+        ids = np.asarray(input_ids, np.int32).reshape(-1)
+        P = int(ids.shape[0])
+        max_new = int(max_new_tokens)
+        if P < 1:
+            raise ValueError("empty prompt")
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens={max_new}: need >= 1")
+        padded = -(-P // self.chunk) * self.chunk
+        need = max(P + max_new, padded)
+        if need > self.cache_len:
+            raise ValueError(
+                f"request needs {need} cache positions (prompt {P} + new "
+                f"{max_new}, chunk-padded {padded}) but slot lanes hold "
+                f"{self.cache_len} — raise serving.max_cache_len or split "
+                f"the request")
+        req = ServeRequest(self._next_rid, ids, max_new, int(eos_token_id),
+                           submitted_it=self._it)
+        self._next_rid += 1
+        self._queue.append(req)
+        return req.rid
+
+    def step(self):
+        """One scheduler iteration: admission prefill under the token
+        budget, one decode-block dispatch, then process device results one
+        event behind (latency-hiding).  Returns ``{rid: output}`` for the
+        requests whose results were processed this iteration."""
+        t0 = time.perf_counter()
+        self._ensure_workspace()
+        finished = {}
+        self._admit()
+        dispatched = self._dispatch_decode()
+        # lag-one processing: with fresh work in flight, leave the newest
+        # event unread so the device/tunnel keeps running while the host
+        # does bookkeeping; once nothing new was dispatched, flush fully
+        self._process_events(finished, keep=1 if dispatched else 0)
+        self._emit_metrics()
+        self.stats["iterations"] += 1
+        self.stats["wall_secs"] += time.perf_counter() - t0
+        self._it += 1
+        return finished
+
+    def drain(self):
+        """Run the scheduler until every submitted request has finished;
+        returns ``{rid: np.ndarray}`` for everything completed during the
+        call."""
+        results = {}
+        while self._queue or self._pending is not None or self._events \
+                or self._mirror_active.any():
+            results.update(self.step())
+        return results
+
+    def close(self):
+        """Return the KV workspaces (the big slot cache, the slot state
+        and the prefill lanes); a later ``step()`` reallocates them.
+        In-flight requests (if any) are aborted — only the queue
+        survives."""
+        finished = {}
+        try:
+            self._process_events(finished, keep=0)
+        except Exception as e:               # dead buffers from a failure
+            logger.warning(f"serving close(): discarding unreadable "
+                           f"in-flight events ({type(e).__name__}: {e})")
+        if finished:
+            logger.warning(f"serving close(): {len(finished)} finished "
+                           f"request(s) discarded unread")
+        self._abort_in_flight("close()")
+        if self._cache is not None:
+            self._cache_ws.give_back(self._cache)
+            self._cache = None
+        self._state = None
+        self._cache_ws.release()
+        self._lane_pool.release()
+
+    def _abort_in_flight(self, why):
+        """Drop every request past admission (its KV rows live in buffers
+        that are dead or about to be re-initialized) and restore the slot
+        bookkeeping to all-free — queued requests survive and the next
+        ``step()`` runs on a fresh workspace.  Without this, a failed
+        decode dispatch would leak the occupied slots forever (drain()
+        then spins: nothing free to admit, nothing active to decode) and
+        stale events would replay against the fresh all-inactive state."""
+        lost = [r.rid for r in self._slots if r is not None]
+        if self._pending is not None:
+            lost.append(self._pending.req.rid)
+            self._lane_pool.give_back(self._pending.lane)
+            self._pending = None
+        self._events.clear()
+        self._slots = [None] * self.num_slots
+        self._free = deque(range(self.num_slots))
+        self._mirror_active[:] = False
+        self._state = None
+        if lost:
+            self.stats["aborted"] = self.stats.get("aborted", 0) + len(lost)
+            logger.warning(f"serving {why}: aborted {len(lost)} in-flight "
+                           f"request(s) {lost} — queued requests survive")
+
+    @property
+    def queue_depth(self):
+        return len(self._queue) + (1 if self._pending is not None else 0)
+
+    @property
+    def active_slots(self):
+        """Live slots as of the last PROCESSED event (the host mirror)."""
+        return int(np.sum(self._mirror_active))
+
+    @property
+    def in_flight(self):
+        """Dispatched device events not yet processed."""
+        return len(self._events)
+
+    # ------------------------------------------------------------------ #
+    # Warmup — compile (or reload) the expensive programs up front
+    # ------------------------------------------------------------------ #
+    def warmup(self, monitor=None):
+        """AOT-compile the expensive serving programs (the decode block
+        and the admission prefill chunk) against abstract arguments —
+        with the ``compile_cache`` block on, a restarted server RELOADS
+        them instead of recompiling (watch
+        ``compile_cache.stats().executable_hits``).  Returns
+        ``{program: compile_seconds}`` (0.0 = warm/store hit).
+
+        The fused admit program deliberately compiles on first use
+        instead: it takes no ``params``, so an abstract-args compile would
+        pin it to single-device input shardings while its runtime inputs
+        (chunk-program outputs) carry the mesh's replicated sharding —
+        first-use compilation sees the real shardings and still
+        round-trips the executable store like everything else."""
+        eng = self.engine
+        N, S, C = self.num_slots, self.cache_len, self.chunk
+        dtype = eng.compute_dtype
+        cache = jax.eval_shape(
+            lambda: self.module.init_cache(N, S, dtype=dtype))
+        lane = jax.eval_shape(
+            lambda: self.module.init_cache(1, S, dtype=dtype))
+        state = {
+            "token": jax.ShapeDtypeStruct((N,), jnp.int32),
+            "pos": jax.ShapeDtypeStruct((N,), jnp.int32),
+            "active": jax.ShapeDtypeStruct((N,), jnp.bool_),
+            "remaining": jax.ShapeDtypeStruct((N,), jnp.int32),
+            "eos": jax.ShapeDtypeStruct((N,), jnp.int32),
+        }
+        rng = jax.eval_shape(lambda: jax.random.key(0))
+        report = {}
+
+        def warm(fn, args, name):
+            from deepspeed_tpu.runtime import compile_cache as cc
+            sig = (id(fn),) + cc.abstract_signature(args)
+            if sig in eng._aot:
+                return {name: 0.0}
+            compiled, dt, hit = eng._aot_compile(fn, args)
+            if compiled is None:
+                logger.warning(f"serving warmup: {name} failed to "
+                               f"AOT-compile — it compiles on first use")
+                return {}
+            eng._aot[sig] = compiled
+            return {name: 0.0 if hit else dt}
+
+        cargs = (eng._params, lane,
+                 jax.ShapeDtypeStruct((1, C), jnp.int32),
+                 jax.ShapeDtypeStruct((), jnp.int32),
+                 jax.ShapeDtypeStruct((1,), jnp.int32))
+        report.update(warm(self._chunk_fn, cargs, f"serving_prefill:c{C}"))
+        report.update(warm(self._decode_fn,
+                           (eng._params, cache, state, rng),
+                           f"serving_decode:n{N}s{S}b{self.block}"))
+        for name, dt in report.items():
+            log_dist(f"serving warmup[{name}]: "
+                     + ("cached" if dt == 0.0 else f"{dt:.1f}s"), ranks=[0])
+        mon = monitor or self.monitor
+        if mon is not None and getattr(mon, "enabled", True):
+            mon.write_events([(f"Compile/{name}_secs", dt, 0)
+                              for name, dt in report.items()])
+        return report
+
+    # ------------------------------------------------------------------ #
+    # Admission: queue -> prefill chunks -> fused admit dispatch
+    # ------------------------------------------------------------------ #
+    def _pop_request(self):
+        if self.config.admission == "shortest_first":
+            req = min(self._queue, key=lambda r: (len(r.ids), r.rid))
+            self._queue.remove(req)
+            return req
+        return self._queue.popleft()
+
+    def _admit(self):
+        limit = self.config.prefill_token_budget or math.inf
+        spent = 0
+        while spent < limit:
+            if self._pending is None:
+                if not self._queue or not self._free:
+                    return
+                self._pending = self._start_prefill(self._pop_request())
+            done = self._run_prefill_chunk(self._pending)
+            spent += self.chunk
+            if done:
+                pend, self._pending = self._pending, None
+                self._dispatch_admit(pend)
+
+    def _start_prefill(self, req):
+        slot = self._free.popleft()
+        req.slot = slot
+        P = len(req.ids)
+        n = -(-P // self.chunk)
+        ids_pad = np.zeros((1, n * self.chunk), np.int32)
+        ids_pad[0, :P] = req.ids
+        lane = self._lane_pool.take(self.cache_len,
+                                    self.engine.compute_dtype)
+        return _PendingPrefill(req, slot, lane, ids_pad, n)
+
+    def _run_prefill_chunk(self, p):
+        C = self.chunk
+        P = len(p.req.ids)
+        local = int(min(max(P - 1 - p.ci * C, 0), C - 1))
+        try:
+            logits, p.lane = self.engine._run_guarded(
+                self._chunk_fn,
+                (self.engine._params, p.lane,
+                 jnp.asarray(p.ids_pad[:, p.ci * C:(p.ci + 1) * C]),
+                 jnp.asarray(p.ci * C, jnp.int32),
+                 jnp.asarray([local], jnp.int32)))
+        except BaseException:
+            # the donated lane may be dead — drop only THIS admission
+            # (the decode workspace is untouched by a prefill failure)
+            self._lane_pool.give_back(p.lane)
+            self._free.append(int(p.slot))
+            self._pending = None
+            logger.warning(f"serving prefill failed — request "
+                           f"{p.req.rid} dropped")
+            raise
+        if (P - 1) // C == p.ci:
+            # this chunk held the prompt's last real position — its
+            # selected logits seed the first sampled token (device-side;
+            # never synchronized here)
+            p.sel = logits
+        p.ci += 1
+        self.stats["prefill_tokens"] += C
+        return p.ci >= p.n_chunks
+
+    def _dispatch_admit(self, p):
+        """Prefill complete: ONE fused dispatch samples the first token,
+        inserts the lane and writes the slot state in-program.  The first
+        token is read lazily when the event is processed."""
+        req = p.req
+        self._rng, sub = jax.random.split(self._rng)
+        try:
+            self._cache, self._state, first = self.engine._run_guarded(
+                self._admit_fn,
+                (self._cache, self._state, p.lane, p.sel, sub,
+                 jnp.asarray(p.slot, jnp.int32),
+                 jnp.asarray(len(req.ids), jnp.int32),
+                 jnp.asarray(req.max_new, jnp.int32),
+                 jnp.asarray(req.eos, jnp.int32)))
+        except BaseException:
+            # cache/state were donated — same recovery as a decode
+            # failure (this admission's request is lost with them)
+            self._cache_ws.give_back(self._cache)
+            self._cache = None
+            self._lane_pool.give_back(p.lane)
+            self._abort_in_flight(f"admit dispatch failed "
+                                  f"(request {req.rid} lost)")
+            raise
+        self._slots[p.slot] = req
+        self._events.append(("admit", req, p.slot, p.lane, first))
+        self.stats["admitted"] += 1
+
+    # ------------------------------------------------------------------ #
+    # Decode: one block of the single reusable decode-step program
+    # ------------------------------------------------------------------ #
+    def _dispatch_decode(self):
+        # dispatch when anything can be live on device: a slot active as
+        # of the mirror, or an unprocessed admit that (probably) went live
+        if not (self._mirror_active.any()
+                or any(e[0] == "admit" for e in self._events)):
+            return False
+        self._rng, sub = jax.random.split(self._rng)
+        try:
+            toks, self._cache, self._state = self.engine._run_guarded(
+                self._decode_fn,
+                (self.engine._params, self._cache, self._state, sub))
+        except BaseException:
+            # the donated cache/state may be dead — drop them so the next
+            # step's workspace take() reallocates, and abort everything
+            # past admission (its KV rows died with the buffers; stale
+            # events/slot bookkeeping must not survive into the fresh
+            # state).  Queued requests are untouched.
+            self._cache_ws.give_back(self._cache)
+            self._cache = None
+            self._abort_in_flight("decode dispatch failed")
+            raise
+        self._events.append(("decode", toks))
+        self.stats["decode_calls"] += 1
+        return True
+
+    # ------------------------------------------------------------------ #
+    # Event processing (the host's lagging mirror of the device)
+    # ------------------------------------------------------------------ #
+    def _process_events(self, finished, keep=0):
+        while len(self._events) > keep:
+            ev = self._events.popleft()
+            if ev[0] == "admit":
+                self._process_admit(ev, finished)
+            else:
+                self._process_decode(ev, finished)
+
+    def _process_admit(self, ev, finished):
+        _, req, slot, lane, first_dev = ev
+        t0 = time.perf_counter()
+        first = int(np.asarray(first_dev))
+        self.stats["sync_secs"] += time.perf_counter() - t0
+        self._lane_pool.give_back(lane)
+        req.tokens = [first]
+        # mirror the admit program's activation rule
+        if (req.eos >= 0 and first == req.eos) or req.max_new == 1:
+            self._slots[slot] = None
+            self._free.append(int(slot))
+            finished[req.rid] = self._finalize(req)
+        else:
+            self._mirror_active[slot] = True
+
+    def _process_decode(self, ev, finished):
+        t0 = time.perf_counter()
+        toks = np.asarray(ev[1])                         # [block, N]
+        self.stats["sync_secs"] += time.perf_counter() - t0
+        # mirror the in-program retirement rule step by step: an emitted
+        # eos (or max_new reached) ends the request and frees its slot
+        for t in range(toks.shape[0]):
+            row = toks[t]
+            for s in np.nonzero(self._mirror_active)[0]:
+                req = self._slots[s]
+                tok = int(row[s])
+                req.tokens.append(tok)
+                self.stats["decode_tokens"] += 1
+                if (req.eos >= 0 and tok == req.eos) \
+                        or len(req.tokens) >= req.max_new:
+                    self._mirror_active[s] = False
+                    self._slots[s] = None
+                    self._free.append(int(s))
+                    finished[req.rid] = self._finalize(req)
+        self.occupancy_trace.append(
+            (self._it, int(self._mirror_active.sum())))
+
+    def _finalize(self, req):
+        """The ``generate()`` output contract: ``[prompt..., tokens...]``
+        of length ``P + max_new_tokens``, eos-padded past an early stop."""
+        req.finished_it = self._it
+        self.stats["completed"] += 1
+        P = len(req.ids)
+        pad = req.eos if req.eos >= 0 else 0
+        out = np.full((P + req.max_new,), pad, np.int32)
+        out[:P] = req.ids
+        out[P:P + len(req.tokens)] = np.asarray(req.tokens, np.int32)
+        return out
+
+    # ------------------------------------------------------------------ #
+    # Plumbing
+    # ------------------------------------------------------------------ #
+    def _ensure_workspace(self):
+        if self._cache is None:
+            self._cache = self._cache_ws.take(
+                self.num_slots, self.cache_len, self.engine.compute_dtype)
+        if self._state is None:
+            self._state = {k: jnp.asarray(v) for k, v in
+                           init_slot_state(self.num_slots).items()}
+            self._mirror_active[:] = False
+
+    def _emit_metrics(self):
+        mon = self.monitor
+        if mon is None or not getattr(mon, "enabled", True):
+            return
+        wall = self.stats["wall_secs"]
+        mon.write_events([
+            ("Serving/queue_depth", self.queue_depth, self._it),
+            ("Serving/slot_occupancy",
+             self.active_slots / self.num_slots, self._it),
+            ("Serving/decode_tok_s",
+             self.stats["decode_tokens"] / wall if wall > 0 else 0.0,
+             self._it),
+            ("Serving/prefill_decode_ratio",
+             self.stats["prefill_tokens"]
+             / max(self.stats["decode_tokens"], 1), self._it),
+            ("Serving/completed", self.stats["completed"], self._it),
+        ])

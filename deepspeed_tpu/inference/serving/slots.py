@@ -1,0 +1,145 @@
+"""Slot-lane programs for the continuous-batching serving engine.
+
+The fixed-shape contract (``docs/serving.md``): the KV workspace holds
+``num_slots`` cache lanes ``[L, num_slots, cache_len, KVH*D]`` and every
+piece of per-slot occupancy state (last token, write position, live flag,
+steps remaining, eos id) is a TRACED argument — so admissions, EOS
+retirements and request churn never change a program shape, and exactly ONE
+decode-step executable serves the whole server lifetime (persisted via the
+compile cache, reloaded across restarts).
+
+Two programs:
+
+* :func:`make_decode_block_fn` — the decode step.  One call advances every
+  slot ``block`` tokens through the model's per-row decode path (rank-1
+  ``start_pos`` selects the scatter cache write and the per-row length
+  masks; free/retired lanes write masked garbage that the next occupant
+  overwrites position-by-position before ever attending to it).  The cache
+  AND the slot state are donated — the workspace updates in place.
+* :func:`make_admit_fn` — admission, fused into one dispatch: sample the
+  first token from the prefill's last-position logits (the SAME sampling
+  rule the decode step uses, ``build_sample_fn`` — keeping serving
+  outputs bitwise equal to solo ``generate()`` runs under greedy
+  decoding), insert the prefilled single-lane cache into the slot's lane
+  (``dynamic_update_slice`` over the traced slot index; cache donated),
+  and write the slot's state entries in-program — so the host scheduler
+  never synchronizes inside the admission path.
+
+Per-step semantics mirror ``make_generate_fn``'s decode loop exactly
+(write K/V at ``pos``, sample from the new logits, emit ``eos`` once done,
+advance ``pos``) — that is what makes the scheduler-correctness contract
+("every request's tokens == its solo generate() run") hold bitwise.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.tools.lint.hotpath import hot_path
+
+# the slot-state pytree: every leaf is a [num_slots] vector, every one a
+# traced argument of the decode step (and donated through it)
+SLOT_STATE_KEYS = ("token", "pos", "active", "remaining", "eos")
+
+
+def init_slot_state(num_slots):
+    """Host-side slot state: all lanes free.  ``eos=-1`` never matches a
+    sampled token (ids are >= 0), so free lanes emit -1 and retire nothing."""
+    import numpy as np
+    return {
+        "token": np.zeros((num_slots,), np.int32),
+        "pos": np.zeros((num_slots,), np.int32),
+        "active": np.zeros((num_slots,), bool),
+        "remaining": np.zeros((num_slots,), np.int32),
+        "eos": np.full((num_slots,), -1, np.int32),
+    }
+
+
+def make_decode_block_fn(module, sample_fn, param_transform, block,
+                         cache_len):
+    """The single reusable decode-step program:
+    ``fn(params, cache, state, rng) -> (tokens [block, N], cache, state)``
+    with the cache and slot state donated (argnums 1, 2).
+
+    Each of the ``block`` in-program steps writes every slot's pending
+    token at its own ``pos`` (per-row scatter write + per-row length
+    mask), samples the next token, emits the slot's ``eos`` for lanes that
+    already finished, and flips ``active`` off when a lane emits its eos
+    or exhausts ``remaining`` — identical math to ``make_generate_fn``'s
+    loop body, so greedy serving tokens match solo ``generate()`` bitwise.
+    Retired/free lanes keep decoding as masked no-ops for at most
+    ``block - 1`` steps until the host scheduler reclaims them; their
+    writes land at a clamped ``pos`` and are overwritten by the next
+    occupant before any of its queries can attend to them.
+    """
+    deq = param_transform if param_transform is not None else (lambda p: p)
+
+    @hot_path("serving.decode_step")
+    def decode_block(params, cache, state, rng):
+        eos = state["eos"]
+
+        def step(carry, _):
+            cache, tok, pos, active, remaining, rng = carry
+            logits, cache = module.apply(deq(params), tok[:, None], cache,
+                                         pos, method=type(module).decode)
+            rng, sub = jax.random.split(rng)
+            nxt = sample_fn(logits[:, -1], sub).astype(jnp.int32)
+            nxt = jnp.where(active, nxt, eos)
+            done_now = active & ((nxt == eos) | (remaining <= 1))
+            active = active & jnp.logical_not(done_now)
+            # clamp: identity for live lanes (submit() bounds
+            # prompt+max_new by cache_len); keeps dead lanes' masked
+            # no-op writes inside the buffer forever
+            pos = jnp.minimum(pos + 1, cache_len - 1)
+            remaining = jnp.maximum(remaining - 1, 0)
+            return (cache, nxt, pos, active, remaining, rng), nxt
+
+        (cache, tok, pos, active, remaining, _), toks = jax.lax.scan(
+            step, (cache, state["token"], state["pos"], state["active"],
+                   state["remaining"], rng), None, length=block)
+        new_state = {"token": tok, "pos": pos, "active": active,
+                     "remaining": remaining, "eos": eos}
+        return toks, cache, new_state
+
+    return jax.jit(decode_block, donate_argnums=(1, 2))
+
+
+def make_admit_fn(sample_fn):
+    """The fused admission program:
+    ``fn(cache, state, lane, logits, rng, slot, pos0, max_new, eos)
+    -> (cache, state, first_token)`` with the cache and slot state
+    donated (argnums 0, 1).
+
+    One dispatch does everything an admission needs ON DEVICE: sample the
+    first token from the prefill's last-position logits (same fp32 rule
+    as the decode step — ``build_sample_fn`` — so greedy admission tokens
+    match solo runs bitwise), write the [L, 1, S, ...] prefilled lane into
+    slot ``slot`` of the big cache (``dynamic_update_slice`` over the
+    traced slot index), and flip the slot's state entries live — inactive
+    when the request already finished at admission (first token == eos,
+    or ``max_new == 1``).  Because the state write happens in-program,
+    the host scheduler never has to synchronize on the first token before
+    the next decode block can be dispatched: it reads ``first_token``
+    lazily, one block behind (see ``ServingEngine``)."""
+
+    @hot_path("serving.admit")
+    def admit(cache, state, lane, logits, rng, slot, pos0, max_new, eos):
+        first = sample_fn(logits[:, 0], rng).astype(jnp.int32)[0]
+
+        def ins(buf, lbuf):
+            return jax.lax.dynamic_update_slice(
+                buf, lbuf.astype(buf.dtype), (0, slot, 0, 0))
+
+        cache = {k: ins(cache[k], lane[k]) for k in cache}
+        # finished-at-admission: eos on the first token (eos=-1 never
+        # matches: sampled ids are >= 0), or a 1-token request
+        active0 = (max_new > 1) & jnp.logical_not(first == eos)
+        upd = lambda arr, val: arr.at[slot].set(val)
+        state = {"token": upd(state["token"], first),
+                 "pos": upd(state["pos"], pos0),
+                 "active": upd(state["active"], active0),
+                 "remaining": upd(state["remaining"],
+                                  jnp.maximum(max_new - 1, 0)),
+                 "eos": upd(state["eos"], eos)}
+        return cache, state, first
+
+    return jax.jit(admit, donate_argnums=(0, 1))

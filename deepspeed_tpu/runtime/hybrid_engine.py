@@ -54,6 +54,12 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         if he.get("quantize_rollouts", False):
             self.set_rollout_quantization(
                 bits=int(he.get("rollout_quant_bits", 8)))
+        # rollout decode-loop form (mirrors the inference config's
+        # decode_early_exit): True (default) = bounded while_loop that
+        # stops once every row hit EOS; False = the fixed-length scan —
+        # the escape hatch if the while form regresses donation or
+        # rollout throughput
+        self._rollout_early_exit = bool(he.get("decode_early_exit", True))
 
     def set_rollout_quantization(self, bits=8):
         """Quantize the inference view per rollout (per-channel, fusable
@@ -278,9 +284,12 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         # through InferenceEngine (the weights are a shared view) to get
         # the split path's memory bounds
         chunk = None
+        # the loop form rides the key — it is part of the program's
+        # identity and the executable-store key derives from this tuple
         key = (input_ids.shape[1], int(max_new_tokens), bool(do_sample),
                float(temperature), int(top_k), float(top_p),
-               attention_mask is not None, chunk)
+               attention_mask is not None, chunk,
+               self._rollout_early_exit)
         self._get_rollout_fn(key)
         params = self._inference_view()
         if getattr(self, "_gen_workspace", None) is None:
@@ -304,11 +313,11 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
     def _get_rollout_fn(self, key):
         """Build (or fetch) the rollout generation program for ``key`` =
         (prompt_len, max_new, do_sample, temperature, top_k, top_p,
-        with_mask, chunk)."""
+        with_mask, chunk, early_exit)."""
         if key not in self._gen_compiled:
             from deepspeed_tpu.inference.engine import make_generate_fn
-            P, new, do_sample, temperature, top_k, top_p, with_mask, chunk \
-                = key
+            (P, new, do_sample, temperature, top_k, top_p, with_mask,
+             chunk, _early_exit) = key
             # carry the rollout view through the decode scan only when its
             # dequant materializes full weights (see WeightQuantization
             # .materializing_dequant); the plain bf16 view stays an
@@ -320,7 +329,8 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                 with_mask=with_mask,
                 carry_params=self._rollout_quantizer is not None
                 and self._rollout_quantizer.materializing_dequant,
-                prefill_chunk=chunk)
+                prefill_chunk=chunk,
+                early_exit=self._rollout_early_exit)
         return self._gen_compiled[key]
 
     def _run_rollout(self, fn, args, key):
@@ -379,7 +389,8 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         params = self._inference_view()
         P, new = int(prompt_len), int(max_new_tokens)
         key = (P, new, bool(do_sample), float(temperature), int(top_k),
-               float(top_p), bool(with_mask), None)
+               float(top_p), bool(with_mask), None,
+               self._rollout_early_exit)
         fn = self._get_rollout_fn(key)
         report = {}
         for B in batch_sizes:

@@ -146,8 +146,69 @@ def inference_prefill_chunk():
                       expect_donation=True)
 
 
+def serving_decode_step():
+    """The serving loop's single reusable decode-step program
+    (``inference/serving/slots.py``): cache AND slot-state donated — the
+    whole continuous-batching design rests on this one executable updating
+    the slot workspace in place with no host callbacks."""
+    from deepspeed_tpu.inference.engine import build_sample_fn
+    from deepspeed_tpu.inference.serving.slots import make_decode_block_fn
+    engine = _tiny_inference_engine()
+    N, S = 2, 32
+    fn = make_decode_block_fn(engine.module,
+                              build_sample_fn(False, 1.0, 0, 1.0),
+                              None, 2, S)
+    cache = engine.module.init_cache(N, S, dtype=engine.compute_dtype)
+    state = {"token": jnp.zeros((N,), jnp.int32),
+             "pos": jnp.asarray([8, 3], jnp.int32),
+             "active": jnp.asarray([True, False]),
+             "remaining": jnp.asarray([4, 0], jnp.int32),
+             "eos": jnp.asarray([-1, -1], jnp.int32)}
+    args = (engine._params, cache, state, jax.random.key(0))
+    return EntryPoint("serving.decode_step", fn, args, expect_donation=True)
+
+
+def serving_admission_prefill():
+    """The serving admission prefill — the engine's donated per-chunk
+    executable at lane width B=1, replayed for every admitted prompt."""
+    engine = _tiny_inference_engine()
+    C = 8
+    chunk_fn = engine._get_chunk_fn(C, 1)
+    lane = engine.module.init_cache(1, 32, dtype=engine.compute_dtype)
+    ids = jnp.asarray(np.random.default_rng(3).integers(0, 97, (1, C)),
+                      jnp.int32)
+    args = (engine._params, lane, ids, jnp.asarray(0, jnp.int32),
+            jnp.zeros((1,), jnp.int32))
+    return EntryPoint("serving.admission_prefill", chunk_fn, args,
+                      expect_donation=True)
+
+
+def serving_admit():
+    """The fused admission program (first-token sample + lane insert +
+    in-program slot-state write; slot index traced, cache AND slot state
+    donated)."""
+    from deepspeed_tpu.inference.engine import build_sample_fn
+    from deepspeed_tpu.inference.serving.slots import make_admit_fn
+    engine = _tiny_inference_engine()
+    fn = make_admit_fn(build_sample_fn(False, 1.0, 0, 1.0))
+    N, S = 2, 32
+    cache = engine.module.init_cache(N, S, dtype=engine.compute_dtype)
+    lane = engine.module.init_cache(1, S, dtype=engine.compute_dtype)
+    state = {"token": jnp.zeros((N,), jnp.int32),
+             "pos": jnp.zeros((N,), jnp.int32),
+             "active": jnp.zeros((N,), bool),
+             "remaining": jnp.zeros((N,), jnp.int32),
+             "eos": jnp.full((N,), -1, jnp.int32)}
+    logits = jnp.zeros((1, 1, 97), jnp.float32)
+    args = (cache, state, lane, logits, jax.random.key(0),
+            jnp.asarray(1, jnp.int32), jnp.asarray(8, jnp.int32),
+            jnp.asarray(4, jnp.int32), jnp.asarray(-1, jnp.int32))
+    return EntryPoint("serving.admit", fn, args, expect_donation=True)
+
+
 BUILDERS = (runtime_train_step, runtime_apply_update, inference_decode,
-            inference_prefill_chunk)
+            inference_prefill_chunk, serving_decode_step,
+            serving_admission_prefill, serving_admit)
 
 
 def iter_entry_points():

@@ -121,6 +121,23 @@ def test_bench_timeout_skips_and_records_prior_phases(tmp_path):
     assert rec["calibration"]["measured_hbm_gbps"] > 0
 
 
+def test_bench_suite_budget_skips_and_records(tmp_path):
+    """BENCH_SUITE_BUDGET caps every phase's timeout at what the suite can
+    still afford and records out-of-budget phases as skipped — the suite
+    always finishes inside the budget with the contract JSON intact (the
+    round-5 rc=124: the budget was only checked between phases, so one
+    phase blew straight through the wrapping driver's window)."""
+    result, stderr = run_bench({"BENCH_PHASES": "calibrate,north",
+                                "BENCH_SUITE_BUDGET": "1"}, tmp_path)
+    assert "skipped" in result["calibration"]
+    assert "skipped" in result["north_star"]
+    assert "suite budget exhausted" in stderr
+    assert result["unit"] == "tokens/s/chip"      # contract line survived
+    with open(tmp_path / "BENCH_partial.json") as f:
+        rec = json.load(f)
+    assert "skipped" in rec["calibration"]
+
+
 def test_bench_interrupt_emits_partial_record(tmp_path):
     """SIGINT mid-suite (a user's Ctrl-C, or a wrapping driver giving up):
     the parent must still emit the driver-contract JSON with every

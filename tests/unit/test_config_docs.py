@@ -20,6 +20,7 @@ def _blocks():
     from deepspeed_tpu.runtime.fault.config import FaultConfig
     from deepspeed_tpu.inference.config import (DeepSpeedInferenceConfig,
                                                 QuantizationConfig)
+    from deepspeed_tpu.inference.serving.config import ServingConfig
     return {
         "fp16": rc.FP16Config,
         "bf16": rc.BF16Config,
@@ -52,6 +53,7 @@ def _blocks():
         "init_inference": DeepSpeedInferenceConfig,
         "init_inference.quant": QuantizationConfig,
         "init_inference.fault": FaultConfig,
+        "init_inference.serving": ServingConfig,
     }
 
 

@@ -586,6 +586,95 @@ def test_split_prefill_generation_matches_one_pass(model_and_params):
     np.testing.assert_array_equal(got_m, want_m)
 
 
+def test_decode_early_exit_matches_scan(model_and_params):
+    """The bounded-while-loop decode form (early_exit=True, the default)
+    must emit BITWISE the scan form's tokens — greedy, with an eos that
+    stops every row early, and sampled with a padded-prompt mask."""
+    from deepspeed_tpu.inference.engine import make_generate_fn
+    model, params, ids = model_and_params
+    rng = jax.random.key(7)
+
+    def run(early, eos=-1, with_mask=False, do_sample=False):
+        fn = make_generate_fn(model, jnp.float32, ids.shape[1], 10,
+                              do_sample, 0.8 if do_sample else 1.0, 0,
+                              0.9 if do_sample else 1.0,
+                              with_mask=with_mask, early_exit=early)
+        cache = model.init_cache(ids.shape[0], ids.shape[1] + 10,
+                                 dtype=jnp.float32)
+        args = (params, cache, ids, rng, jnp.asarray(eos))
+        if with_mask:
+            mask = np.ones(ids.shape, np.int32)
+            mask[1, -3:] = 0
+            args += (jnp.asarray(mask),)
+        return np.asarray(fn(*args)[0])
+
+    np.testing.assert_array_equal(run(True), run(False))
+    # eos = whatever greedy emits 2 tokens in: every row stops early, the
+    # while form exits, and the eos-prefilled tail must match the scan's
+    eos = int(run(False)[0, ids.shape[1] + 2])
+    np.testing.assert_array_equal(run(True, eos=eos), run(False, eos=eos))
+    np.testing.assert_array_equal(run(True, with_mask=True, do_sample=True),
+                                  run(False, with_mask=True, do_sample=True))
+
+
+def test_decode_early_exit_engine_flag(model_and_params):
+    """``decode_early_exit`` plumbs through the engine; both settings
+    generate identical tokens (the flag only changes the loop form)."""
+    model, params, ids = model_and_params
+    outs = []
+    for flag in (True, False):
+        eng = deepspeed_tpu.init_inference(
+            model, config={"dtype": "float32", "decode_early_exit": flag})
+        eng.set_params(params)
+        first = int(np.asarray(eng.generate(ids, max_new_tokens=1))[0, -1])
+        outs.append(np.asarray(eng.generate(ids, max_new_tokens=8,
+                                            eos_token_id=first)))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_kv_workspace_dead_buffer_not_reused(model_and_params):
+    """take()/give_back() liveness (serving + generate share this): a
+    buffer donated into a program that FAILED after donation comes back
+    dead — take() must reallocate, never hand a deleted array out."""
+    from deepspeed_tpu.inference.engine import KVCacheWorkspace
+    model, params, ids = model_and_params
+    ws = KVCacheWorkspace(model)
+    cache = ws.take(2, 32, jnp.float32)
+    # simulate a post-donation failure: every leaf buffer is dead
+    for leaf in jax.tree.leaves(cache):
+        leaf.delete()
+    ws.give_back(cache)
+    fresh = ws.take(2, 32, jnp.float32)
+    assert all(not l.is_deleted() for l in jax.tree.leaves(fresh))
+    np.testing.assert_array_equal(np.asarray(fresh["k"]),
+                                  np.zeros_like(np.asarray(fresh["k"])))
+
+    # a LIVE give-back of the same shape is reused (buffer lineage kept)
+    ws.give_back(fresh)
+    again = ws.take(2, 32, jnp.float32)
+    assert again is fresh["k"] or again["k"] is fresh["k"]
+    # shape change reallocates; release() drops everything
+    ws.give_back(again)
+    other = ws.take(2, 48, jnp.float32)
+    assert other["k"].shape[-2] == 48
+    ws.release()
+    assert ws._cache is None and ws._key is None
+
+
+def test_kv_workspace_partial_death_reallocates(model_and_params):
+    """Even ONE dead leaf (quantized caches carry four) poisons the
+    buffer: take() must treat the whole cache as dead."""
+    from deepspeed_tpu.inference.engine import KVCacheWorkspace
+    model = Transformer(tiny_cfg(kv_cache_quant=True))
+    ws = KVCacheWorkspace(model)
+    cache = ws.take(1, 16, jnp.float32)
+    assert set(cache) == {"k", "v", "k_scale", "v_scale"}
+    cache["v_scale"].delete()
+    ws.give_back(cache)
+    fresh = ws.take(1, 16, jnp.float32)
+    assert all(not l.is_deleted() for l in jax.tree.leaves(fresh))
+
+
 def test_prefill_chunk_size_alignment(model_and_params):
     """User-specified prefill_chunk_size is rounded UP to a multiple of 8
     (floor 8, cap 512 — the Mosaic chunk kernel's alignment and VMEM

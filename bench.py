@@ -529,6 +529,110 @@ def serving_bench(model_name="opt-1.3b", *, num_slots=8, n_requests=24,
     }
 
 
+def serving_overload_bench(model_name="opt-1.3b", *, num_slots=8,
+                           burst_factor=4, decode_block=8,
+                           prefill_chunk=128):
+    """Serving SLO micro-phase (``docs/serving.md`` "Robustness & SLOs"):
+    a burst of ``burst_factor``x slot capacity submits with mixed
+    deadlines — a quarter of the burst arrives already expired and must
+    SHED before occupying a slot — then a graceful preemption mid-burst
+    (drain in-flight slots, crash-atomic snapshot) and a second server
+    resuming the snapshot to finish the backlog.  Records the shed rate,
+    p50/p99 time-to-first-token of the completed requests, the
+    preemption drain+snapshot latency, and the per-server decode-
+    executable count (the one-decode-executable invariant under
+    overload + drain + resume)."""
+    import shutil
+    import tempfile
+    import jax
+    from deepspeed_tpu.models.opt import opt_config
+    from deepspeed_tpu.models.transformer import Transformer
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+
+    cache_len = 384                         # prompts <= 256, new <= 128
+    n_requests = num_slots * burst_factor
+    cfg = opt_config(model_name, max_seq_len=cache_len, dtype="bfloat16",
+                     scan_layers=False)
+    model = Transformer(cfg)
+    eng = InferenceEngine(model, DeepSpeedInferenceConfig(
+        dtype="bfloat16", compile_cache=_cc_block(),
+        serving={"enabled": True, "num_slots": num_slots,
+                 "max_cache_len": cache_len,
+                 "prefill_chunk": prefill_chunk,
+                 "prefill_token_budget": 256,
+                 "decode_block": decode_block,
+                 "drain_budget_s": 60.0}))
+    eng.init_params()
+    rng = np.random.default_rng(0)
+    prompt_lens = rng.choice([64, 96, 128, 192, 256], n_requests)
+    new_lens = rng.choice([16, 32, 64, 128], n_requests)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(p),)).astype(np.int32)
+               for p in prompt_lens]
+    # mixed deadlines: every 4th request arrives already expired — the
+    # deterministic shed-rate floor; the rest are deadline-free
+    deadlines = [0.0 if i % 4 == 3 else None for i in range(n_requests)]
+
+    srv = eng.serve()
+    srv.warmup()
+    t0 = time.perf_counter()
+    rids = [srv.submit(p, max_new_tokens=int(n), deadline_s=dl)
+            for p, n, dl in zip(prompts, new_lens, deadlines)]
+    live = [r for r, dl in zip(rids, deadlines) if dl is None]
+    done = {}
+    # run the burst until half the live requests completed, then preempt
+    # mid-flight (in-flight slots drain under the budget, the queued
+    # backlog snapshots)
+    it = 0
+    while sum(1 for r in live if r in done) < len(live) // 2:
+        done.update(srv.step())
+        it += 1
+        if it > 100000:                     # parent timeout is the real
+            break                           # guard; this bounds the loop
+    snap_dir = tempfile.mkdtemp(prefix="bench_serving_snap_")
+    try:
+        t_pre = time.perf_counter()
+        tag, snapped, fin = srv.preempt(snap_dir)
+        drain_latency = time.perf_counter() - t_pre
+        done.update(fin)
+        srv2 = eng.serve()
+        restored = srv2.restore(snap_dir)
+        done.update(srv2.drain())
+        t_total = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(snap_dir, ignore_errors=True)
+    results = {**srv._results, **srv2._results}
+    ttfts = sorted(r.ttft_s for r in results.values()
+                   if r.status == "COMPLETED" and r.ttft_s is not None)
+    shed = srv.stats["shed"] + srv2.stats["shed"]
+    completed = srv.stats["completed"] + srv2.stats["completed"]
+    useful = sum(int(n) for r, n in zip(rids, new_lens)
+                 if results[r].status == "COMPLETED")
+    return {
+        "model": model_name,
+        "num_slots": num_slots,
+        "burst_requests": n_requests,
+        "burst_factor": burst_factor,
+        "shed": shed,
+        "shed_rate": round(shed / n_requests, 3),
+        "completed": completed,
+        "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 3)
+        if ttfts else None,
+        "ttft_p99_s": round(float(np.percentile(ttfts, 99)), 3)
+        if ttfts else None,
+        "drain_snapshot_latency_s": round(drain_latency, 3),
+        "snapshotted_requests": len(snapped),
+        "resumed_requests": len(restored),
+        "useful_tokens_per_sec": round(useful / t_total, 1),
+        "total_time_s": round(t_total, 3),
+        # the one-decode-executable invariant under overload+drain+resume
+        "decode_executables_per_server": [
+            sum(1 for sig in eng._aot if sig and sig[0] == id(s._decode_fn))
+            for s in (srv, srv2)],
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def long_context_bench(model_name="opt-1.3b", *, seq=8192, micro_bs=1,
                        steps=4):
     """Long-context SFT through the Pallas flash-attention path (the
@@ -847,6 +951,14 @@ PHASES = [
     ("serving_continuous_batching", "serving",
      lambda fb: serving_bench("opt-1.3b", num_slots=4 if fb else 8,
                               n_requests=12 if fb else 24)),
+    # serving SLO micro-phase: 4x-capacity burst with mixed deadlines →
+    # shed rate, p50/p99 TTFT, graceful-preemption drain latency and the
+    # one-decode-executable invariant — cheap-first, right behind the
+    # serving phase whose programs it shares
+    ("serving_overload", "serving_overload",
+     lambda fb: serving_overload_bench("opt-1.3b",
+                                       num_slots=4 if fb else 8,
+                                       burst_factor=3 if fb else 4)),
     ("generation_int8", "decode_int8",
      lambda fb: decode_bench("opt-1.3b", int8=True,
                              batch_size=8 if fb else 16)),

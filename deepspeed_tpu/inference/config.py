@@ -93,7 +93,10 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     fault: FaultConfig = Field(default_factory=FaultConfig)
     # continuous-batching serving (inference/serving/, docs/serving.md):
     # slot-based in-flight batching behind ``engine.serve()`` — default
-    # off = current whole-batch generate() behavior
+    # off = current whole-batch generate() behavior.  The block also
+    # carries the serving SLO knobs (deadlines, bounded-queue
+    # backpressure, circuit breaker, drain timeout/budget — the
+    # "Robustness & SLOs" section of docs/serving.md)
     serving: ServingConfig = Field(default_factory=ServingConfig)
     # decode loop form: True (default) runs the generation decode loop as
     # a bounded lax.while_loop that stops once every row hit EOS (short

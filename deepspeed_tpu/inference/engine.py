@@ -74,6 +74,14 @@ class InferenceEngine:
         self._workspace = KVCacheWorkspace(model)
         self._aot = {}
         self._tags = {}          # id(jit fn) -> stable program tag
+        # ids of jitted fns that must NOT touch the persistent caches —
+        # neither the serialized-executable store nor the XLA disk cache.
+        # The serving slot programs register here: reloading any of them
+        # in a fresh process nondeterministically corrupts the slot
+        # workspace or segfaults (see ServingEngine.__init__ /
+        # compile_cache.suspended_persistent_cache); they recompile once
+        # per process instead
+        self._persist_opt_out = set()
         # persistent compile/executable cache (None = disabled: the AOT
         # path below still compiles per process, just without disk reuse)
         self._program_cache = compile_cache_mod.ProgramCache.from_config(
@@ -390,19 +398,26 @@ class InferenceEngine:
             self._workspace.give_back(cache)
         return out
 
+    def _make_chunk_fn(self):
+        """A fresh (unmemoized) per-chunk prefill program instance — the
+        serving engine uses its own instance so its persist-opt-out never
+        touches the engine-shared one (and a store-reloaded shared
+        executable can never serve admission prefill)."""
+        module, deq = self.module, self._deq
+
+        @hot_path("inference.prefill_chunk")
+        def chunk_step(params, cache, chunk_ids, start, logits_at):
+            return module.apply(deq(params), chunk_ids, cache, start,
+                                method=type(module).decode,
+                                logits_at=logits_at)
+        return jax.jit(chunk_step, donate_argnums=(1,))
+
     def _get_chunk_fn(self, C, B):
         """The per-chunk prefill executable of the split-prefill path (one
         donated-cache program replayed for every chunk)."""
         ck = ("chunkfill", C, B)
         if ck not in self._compiled:
-            module, deq = self.module, self._deq
-
-            @hot_path("inference.prefill_chunk")
-            def chunk_step(params, cache, chunk_ids, start, logits_at):
-                return module.apply(deq(params), chunk_ids, cache, start,
-                                    method=type(module).decode,
-                                    logits_at=logits_at)
-            self._compiled[ck] = jax.jit(chunk_step, donate_argnums=(1,))
+            self._compiled[ck] = self._make_chunk_fn()
             self._tags[id(self._compiled[ck])] = ck
         return self._compiled[ck]
 
@@ -497,8 +512,15 @@ class InferenceEngine:
                 raise
             if compiled is None:
                 # AOT path is an optimization + guardrail; never let it
-                # block generation (fall back to the plain jit call)
+                # block generation (fall back to the plain jit call).
+                # Opt-out programs must stay cache-detached here too — a
+                # fallback jit compile with the XLA disk cache attached
+                # could reload exactly the cross-process executable the
+                # opt-out exists to avoid
                 self._aot[sig] = fn
+                if id(fn) in self._persist_opt_out:
+                    with compile_cache_mod.suspended_persistent_cache():
+                        return fn(*args)
                 return fn(*args)
             self._aot[sig] = compiled
         return compiled(*args)
@@ -550,12 +572,20 @@ class InferenceEngine:
         from deepspeed_tpu.runtime.fault import inject as fault_inject
         fault_inject.fire("infer.executable_load")
         tag = self._tags.get(id(fn))
-        compiled, dt, hit = compile_cache_mod.aot_compile_with_store(
-            self._program_cache if tag is not None else None,
-            f"infer:{tag[0] if tag else 'untagged'}",
-            (tag, compile_cache_mod.abstract_signature(args),
-             self._cache_context()),
-            fn, args)
+        if id(fn) in self._persist_opt_out:
+            # fresh compile with BOTH persistent layers detached (see
+            # _persist_opt_out above) — once per process per signature
+            with compile_cache_mod.suspended_persistent_cache():
+                compiled, dt, hit = compile_cache_mod.aot_compile_with_store(
+                    None, f"infer:{tag[0] if tag else 'untagged'}",
+                    (), fn, args)
+        else:
+            compiled, dt, hit = compile_cache_mod.aot_compile_with_store(
+                self._program_cache if tag is not None else None,
+                f"infer:{tag[0] if tag else 'untagged'}",
+                (tag, compile_cache_mod.abstract_signature(args),
+                 self._cache_context()),
+                fn, args)
         if compiled is None:
             return None, 0.0, False
         # guard BEFORE caching: under strict_memory every retry with

@@ -11,12 +11,22 @@ never recompile anything).
 """
 
 from deepspeed_tpu.inference.serving.config import ServingConfig
+from deepspeed_tpu.inference.serving.slo import (CircuitBreaker,
+                                                 CircuitOpen, DrainTimeout,
+                                                 QueueFull, RequestResult,
+                                                 RequestStatus)
 
-__all__ = ["ServingConfig", "ServingEngine", "ServeRequest"]
+__all__ = ["ServingConfig", "ServingEngine", "ServeRequest",
+           "RequestStatus", "RequestResult", "QueueFull", "CircuitOpen",
+           "DrainTimeout", "CircuitBreaker", "serve_resilient"]
 
 
 def __getattr__(name):
     if name in ("ServingEngine", "ServeRequest"):
         from deepspeed_tpu.inference.serving import engine as _engine
         return getattr(_engine, name)
+    if name == "serve_resilient":
+        from deepspeed_tpu.inference.serving.resilient import \
+            serve_resilient
+        return serve_resilient
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

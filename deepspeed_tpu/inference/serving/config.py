@@ -44,3 +44,30 @@ class ServingConfig(DeepSpeedConfigModel):
     top_k: int = 0
     top_p: float = 1.0
     seed: int = 0
+    # ---- robustness / SLO knobs (docs/serving.md "Robustness & SLOs",
+    # inference/serving/slo.py) — every default = seed behavior ----
+    # bounded-queue admission control: submit() beyond this depth either
+    # rejects (QueueFull) or blocks running scheduler iterations inline
+    # until a spot frees; 0 = unbounded (seed behavior)
+    max_queue_depth: int = 0
+    queue_policy: str = "reject"          # "reject" | "block"
+    # default per-request wall-clock deadline (seconds from submit);
+    # submit(deadline_s=...) overrides per request; 0 = no deadline.
+    # Expired-while-queued requests are SHED before ever occupying a
+    # slot; in-slot expiry retires at the next scheduling point
+    default_deadline_s: float = 0.0
+    # dispatch circuit breaker: this many CONSECUTIVE failed
+    # decode/admit/prefill dispatches trip it open — failures are
+    # absorbed (requests -> ABORTED), admission stops and submit()
+    # rejects with reason until the cooldown's half-open probe succeeds.
+    # 0 = off (seed behavior: dispatch failures propagate to the caller)
+    breaker_threshold: int = 0
+    breaker_cooldown_s: float = 30.0
+    # drain() wall-clock timeout: raise DrainTimeout with per-slot
+    # diagnostics instead of spinning forever on a wedged scheduler;
+    # 0 = off (seed behavior)
+    drain_timeout_s: float = 0.0
+    # graceful-preemption drain budget (preempt()): keep decoding
+    # in-flight slots for up to this many seconds before snapshotting
+    # the remainder; 0 = snapshot immediately, no drain
+    drain_budget_s: float = 30.0

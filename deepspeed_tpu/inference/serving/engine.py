@@ -8,7 +8,7 @@ The scheduler loop per iteration (:meth:`ServingEngine.step`):
 1. **Admission** — while a KV slot is free and the queue is non-empty,
    pop a request (``fcfs`` or ``shortest_first``) and stream its prompt
    through the engine's donated per-chunk prefill executable
-   (``_get_chunk_fn(C, 1)`` — the same program the split-prefill
+   (a dedicated instance of the same chunk program the split-prefill
    ``generate()`` path replays) into a single-lane cache, spending at most
    ``prefill_token_budget`` prompt tokens per iteration so a long prompt
    cannot starve decoding.  A finished prefill dispatches ONE fused admit
@@ -33,15 +33,32 @@ idle per retirement.
 
 Because slot occupancy rides traced arguments, the whole server lifetime
 compiles exactly ONE decode-step executable per (num_slots, cache_len,
-block, sampling) configuration — persisted through the ``compile_cache``
-block and reloaded (not recompiled) across server restarts.
+block, sampling) configuration.  The serving programs compile once per
+PROCESS and deliberately bypass the persistent cache layers — reloaded
+serving executables corrupt the donated slot workspace (see the
+``_persist_opt_out`` note in ``__init__``).
+
+**Robustness / SLO layer** (``docs/serving.md`` "Robustness & SLOs"):
+every request ends in a typed terminal status (``COMPLETED`` |
+``SHED_DEADLINE`` | ``CANCELLED`` | ``ABORTED``); per-request wall-clock
+deadlines shed queued work before it ever occupies a slot and retire
+in-slot work at the next scheduling point; the queue is bounded
+(``max_queue_depth`` + reject-or-block); a circuit breaker trips after N
+consecutive failed dispatches and rejects-with-reason instead of
+hammering a sick device; and graceful preemption (:meth:`preempt`)
+drains in-flight slots under a budget then snapshots the remainder
+through the crash-atomic checkpoint protocol, so a restarted server
+(:meth:`restore`) resumes them with greedy outputs bitwise-identical to
+an uninterrupted run.  All of it is host bookkeeping riding the existing
+traced slot arguments — no new program shapes, the one-decode-executable
+invariant holds through overload, drain and resume.
 """
 
 import math
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Any, Optional
 
 import numpy as np
 
@@ -49,15 +66,28 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.inference.serving.config import ServingConfig
+from deepspeed_tpu.inference.serving.slo import (CircuitBreaker,
+                                                 DrainTimeout, QueueFull,
+                                                 RequestResult,
+                                                 RequestStatus,
+                                                 TERMINAL_STATUSES)
 from deepspeed_tpu.inference.serving.slots import (init_slot_state,
                                                    make_admit_fn,
                                                    make_decode_block_fn)
+from deepspeed_tpu.runtime.fault import inject
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
 @dataclass
 class ServeRequest:
-    """One queued/running generation request (host bookkeeping only)."""
+    """One queued/running generation request (host bookkeeping only).
+
+    ``prefix`` holds tokens ALREADY generated in a previous server
+    incarnation (graceful-preemption resume): admission prefills
+    ``ids + prefix`` and the device decodes only the remaining budget —
+    the greedy continuation is bitwise what the uninterrupted run would
+    have produced.  ``deadline`` is an absolute ``time.monotonic()``
+    instant (``None`` = no deadline)."""
     rid: int
     ids: np.ndarray                  # [P] int32 prompt
     max_new: int
@@ -66,16 +96,31 @@ class ServeRequest:
     tokens: list = field(default_factory=list)
     slot: Optional[int] = None
     finished_it: Optional[int] = None
+    status: str = RequestStatus.QUEUED
+    deadline: Optional[float] = None
+    client_id: Any = None
+    prefix: list = field(default_factory=list)
+    submit_t: float = 0.0
+    first_tok_t: Optional[float] = None
+
+    @property
+    def fill_ids(self):
+        """What admission prefills: the prompt plus any resumed tokens."""
+        if not self.prefix:
+            return self.ids
+        return np.concatenate(
+            [self.ids, np.asarray(self.prefix, np.int32)])
 
 
 class _PendingPrefill:
     """An admission in progress: the slot is reserved, the prompt streams
     chunk-by-chunk into the lane cache across scheduler iterations."""
 
-    def __init__(self, req, slot, lane, ids_pad, n_chunks):
+    def __init__(self, req, slot, lane, ids_pad, n_chunks, fill_len):
         self.req, self.slot, self.lane = req, slot, lane
         self.ids_pad = ids_pad           # [1, n_chunks*C] int32
         self.n_chunks = n_chunks
+        self.fill_len = fill_len         # real positions incl. resume prefix
         self.ci = 0                      # chunks completed
         self.sel = None                  # last-real-position logits [1,1,V]
 
@@ -164,7 +209,31 @@ class ServingEngine:
             sampling_key)
         engine._tags[id(self._admit_fn)] = (
             "serving_admit", self.num_slots, self.cache_len, sampling_key)
-        self._chunk_fn = engine._get_chunk_fn(self.chunk, 1)
+        # The serving programs must NOT be reloaded from either
+        # persistent cache layer (serialized-executable store OR the XLA
+        # disk cache): they chain one donated slot workspace across three
+        # different programs (chunk lane -> admit insert -> decode
+        # blocks), and running ANY of them from a cross-process reloaded
+        # artifact nondeterministically corrupts the slot cache — wrong
+        # tokens, cross-lane mixing, one lane's KV clobbered the moment
+        # another lane admits — or segfaults outright (reproduced and
+        # bisected with the serving kill-harness driver: cache-less runs
+        # are 100% stable, warm runs flake at ~25-50%; the train and
+        # whole-batch generate paths show no such failures and keep both
+        # layers).  The admission chunk program is a DEDICATED instance
+        # (same body as the engine-shared ('chunkfill', C, 1) memo, via
+        # _make_chunk_fn): the shared one may already sit in eng._aot as
+        # a store-reloaded executable from warmup()/batch-1 split
+        # prefill, and opting IT out would strip generate()'s batch-1
+        # path of its caches.  Each server process compiles its three
+        # serving programs once — the one-decode-executable-per-server-
+        # lifetime invariant is untouched, and overload/drain/resume
+        # cycles mint no further executables
+        # (tests/unit/test_serving_slo.py).
+        self._chunk_fn = engine._make_chunk_fn()
+        engine._tags[id(self._chunk_fn)] = ("serving_prefill", self.chunk)
+        for fn in (self._decode_fn, self._admit_fn, self._chunk_fn):
+            engine._persist_opt_out.add(id(fn))
 
         self._cache_ws = KVCacheWorkspace(self.module)
         self._lane_pool = _LanePool(self.module)
@@ -184,23 +253,56 @@ class ServingEngine:
         self._rng = jax.random.key(int(cfg.seed))
         self._next_rid = 0
         self._it = 0
+        # ---- robustness / SLO state (docs/serving.md) ----
+        if cfg.queue_policy not in ("reject", "block"):
+            raise ValueError(f"serving.queue_policy={cfg.queue_policy!r}: "
+                             f"one of 'reject', 'block'")
+        self._requests = {}              # rid -> ServeRequest (all known)
+        self._results = {}               # rid -> RequestResult (terminal)
+        self._pending_reports = {}       # rid -> None, merged into step()
+        self._breaker = CircuitBreaker(cfg.breaker_threshold,
+                                       cfg.breaker_cooldown_s)
+        self._closed = False
+        self._close_report = []          # undrained rids close() reported
+        self._snap_seq = 0               # snapshot tag lineage counter
+        self._slot_last_dispatch = {}    # slot -> monotonic dispatch time
         # observability (docs/serving.md): scheduler counters + the
         # slot-occupancy trace the correctness test asserts EOS-mid-flight
         # retirement against
         self.stats = {"iterations": 0, "decode_calls": 0,
                       "decode_tokens": 0, "prefill_tokens": 0,
                       "completed": 0, "admitted": 0, "wall_secs": 0.0,
-                      "sync_secs": 0.0}
+                      "sync_secs": 0.0, "shed": 0, "cancelled": 0,
+                      "resumed": 0}
         self.occupancy_trace = []                  # (iteration, n_active)
 
     # ------------------------------------------------------------------ #
     # Public API
     # ------------------------------------------------------------------ #
-    def submit(self, input_ids, max_new_tokens=32, eos_token_id=-1):
+    def submit(self, input_ids, max_new_tokens=32, eos_token_id=-1,
+               deadline_s=None, client_id=None):
         """Enqueue one prompt; returns the request id.  The request must
         fit a slot lane: ``ceil(P/chunk)*chunk <= max_cache_len`` (chunked
         prefill writes the padded tail) and ``P + max_new_tokens <=
-        max_cache_len``."""
+        max_cache_len``.
+
+        ``deadline_s`` (seconds from now; ``None`` = the config's
+        ``default_deadline_s``, ``0`` = already expired): past it the
+        request is SHED from the queue before ever occupying a slot, or
+        retired at the next scheduling point once in a slot — terminal
+        status ``SHED_DEADLINE``.  ``client_id`` is an opaque correlation
+        value round-tripped through results and preemption snapshots
+        (snapshots store it as JSON: non-serializable values are
+        stringified, tuples come back as lists).
+
+        Raises :class:`~.slo.QueueFull` when the bounded queue is at
+        ``max_queue_depth`` under the ``reject`` policy (``block`` runs
+        scheduler iterations inline until a spot frees), and
+        :class:`~.slo.CircuitOpen` while the dispatch breaker is open."""
+        if self._closed:
+            raise RuntimeError(
+                "submit() on a closed ServingEngine — close() retired it; "
+                "create a fresh server with engine.serve()")
         ids = np.asarray(input_ids, np.int32).reshape(-1)
         P = int(ids.shape[0])
         max_new = int(max_new_tokens)
@@ -216,22 +318,178 @@ class ServingEngine:
                 f"{max_new}, chunk-padded {padded}) but slot lanes hold "
                 f"{self.cache_len} — raise serving.max_cache_len or split "
                 f"the request")
+        self._breaker.check_submit()         # reject-with-reason when open
+        self._apply_backpressure()
+        if deadline_s is None and self.config.default_deadline_s > 0:
+            deadline_s = self.config.default_deadline_s
+        deadline = None if deadline_s is None \
+            else time.monotonic() + float(deadline_s)
         req = ServeRequest(self._next_rid, ids, max_new, int(eos_token_id),
-                           submitted_it=self._it)
+                           submitted_it=self._it, deadline=deadline,
+                           client_id=client_id, submit_t=time.monotonic())
         self._next_rid += 1
         self._queue.append(req)
+        self._requests[req.rid] = req
         return req.rid
 
+    def _apply_backpressure(self):
+        depth = int(self.config.max_queue_depth)
+        if not depth or len(self._queue) < depth:
+            return
+        if self.config.queue_policy == "reject":
+            raise QueueFull(
+                f"serving queue at max_queue_depth={depth} "
+                f"(policy=reject) — retry later or raise the bound")
+        # block: run the scheduler inline until a spot frees.  Progress is
+        # guaranteed while anything can retire or admit; an open breaker
+        # with an idle scheduler cannot make progress — reject then.
+        while len(self._queue) >= depth:
+            if self._breaker.open and not self._breaker.allow_dispatch() \
+                    and not (self._events or self._mirror_active.any()
+                             or self._pending is not None):
+                raise QueueFull(
+                    f"serving queue at max_queue_depth={depth} and the "
+                    f"blocked submit cannot make progress: "
+                    f"{self._breaker.last_error or 'circuit open'}")
+            self.step()
+
+    def cancel(self, rid):
+        """Client cancellation.  A queued request is retired immediately
+        (never occupies a slot); an in-slot request is retired at this
+        scheduling point — its slot returns to the free list and any
+        tokens still in flight for it are discarded.  Terminal status
+        ``CANCELLED``.  Returns ``False`` for unknown or already-terminal
+        requests."""
+        req = self._requests.get(rid)
+        if req is None or req.status in TERMINAL_STATUSES \
+                or req.status == RequestStatus.PREEMPTED:
+            return False
+        self.stats["cancelled"] += 1
+        if req in self._queue:
+            self._queue.remove(req)
+            self._record_terminal(req, RequestStatus.CANCELLED,
+                                  "cancelled while queued")
+            return True
+        if self._pending is not None and self._pending.req is req:
+            self._lane_pool.give_back(self._pending.lane)
+            self._free.append(int(self._pending.slot))
+            self._pending = None
+            self._record_terminal(req, RequestStatus.CANCELLED,
+                                  "cancelled during admission prefill")
+            return True
+        self._record_terminal(req, RequestStatus.CANCELLED,
+                              f"cancelled in slot {req.slot}")
+        self._retire_slot_host_side(req)
+        return True
+
+    def status(self, rid):
+        """The request's :class:`~.slo.RequestStatus` string."""
+        return self._requests[rid].status
+
+    def result(self, rid):
+        """The terminal :class:`~.slo.RequestResult`, or ``None`` while
+        the request is still queued/running."""
+        return self._results.get(rid)
+
+    def _retire_slot_host_side(self, req):
+        """Free a retired request's slot in the HOST MIRROR only — the
+        device lane keeps masked-no-op decoding until the slot's next
+        occupant's admit program overwrites its state wholesale (the same
+        overwrite every admission performs), so retirement never needs a
+        device round trip or a new program.  When the request's admit
+        event is still in flight (mirror not yet active), the slot is
+        freed by ``_process_admit`` when the event arrives."""
+        s = req.slot
+        if s is not None and self._mirror_active[s]:
+            self._mirror_active[s] = False
+            self._slots[s] = None
+            self._free.append(int(s))
+
+    def _record_terminal(self, req, status, detail):
+        """Mark a non-COMPLETED terminal outcome and queue it for the
+        next ``step()`` return (output ``None``)."""
+        req.status = status
+        req.finished_it = self._it
+        ttft = (req.first_tok_t - req.submit_t) \
+            if req.first_tok_t is not None else None
+        self._results[req.rid] = RequestResult(
+            rid=req.rid, status=status, output=None, detail=detail,
+            client_id=req.client_id, submitted_it=req.submitted_it,
+            finished_it=self._it, ttft_s=ttft)
+        self._pending_reports[req.rid] = None
+
+    def _shed_expired(self):
+        """Deadline enforcement at the scheduling point: expired QUEUED
+        requests are shed before admission (they never occupy a slot);
+        expired pending-prefill / in-slot requests are retired host-side
+        (see :meth:`_retire_slot_host_side`)."""
+        now = time.monotonic()
+        expired = [r for r in self._queue
+                   if r.deadline is not None and now >= r.deadline]
+        for req in expired:
+            self._queue.remove(req)
+            self.stats["shed"] += 1
+            self._record_terminal(
+                req, RequestStatus.SHED_DEADLINE,
+                f"deadline expired {now - req.deadline:.3f}s ago while "
+                f"queued (never occupied a slot)")
+        p = self._pending
+        if p is not None and p.req.deadline is not None \
+                and now >= p.req.deadline:
+            self._lane_pool.give_back(p.lane)
+            self._free.append(int(p.slot))
+            self._pending = None
+            self.stats["shed"] += 1
+            self._record_terminal(p.req, RequestStatus.SHED_DEADLINE,
+                                  "deadline expired during admission "
+                                  "prefill")
+        for req in list(self._slots):
+            if req is None or req.deadline is None or now < req.deadline \
+                    or req.status in TERMINAL_STATUSES:
+                continue
+            self.stats["shed"] += 1
+            self._record_terminal(req, RequestStatus.SHED_DEADLINE,
+                                  f"deadline expired in slot {req.slot} "
+                                  f"after {len(req.tokens)} token(s)")
+            self._retire_slot_host_side(req)
+
     def step(self):
-        """One scheduler iteration: admission prefill under the token
-        budget, one decode-block dispatch, then process device results one
-        event behind (latency-hiding).  Returns ``{rid: output}`` for the
-        requests whose results were processed this iteration."""
+        """One scheduler iteration: deadline shedding, admission prefill
+        under the token budget, one decode-block dispatch, then process
+        device results one event behind (latency-hiding).  Returns
+        ``{rid: output}`` for every request that reached a terminal
+        status this iteration — ``np.ndarray`` for ``COMPLETED``,
+        ``None`` for shed/cancelled/aborted (typed detail via
+        :meth:`result`)."""
+        if self._closed:
+            raise RuntimeError("step() on a closed ServingEngine")
         t0 = time.perf_counter()
+        inject.fire("serving.sigterm_at_iter")
         self._ensure_workspace()
         finished = {}
-        self._admit()
-        dispatched = self._dispatch_decode()
+        self._shed_expired()
+        if self._breaker.enabled:
+            # breaker mode: dispatch failures are ABSORBED (the except
+            # blocks below already restored the bookkeeping and recorded
+            # ABORTED results) and counted; `threshold` consecutive ones
+            # open the breaker — no dispatches until the cooldown's
+            # half-open probe, and submit() rejects with the reason
+            dispatched = False
+            try:
+                if self._breaker.allow_dispatch():
+                    self._admit()
+                    dispatched = self._dispatch_decode()
+            except Exception as e:
+                self._breaker.record_failure(e)
+                logger.warning(
+                    f"serving dispatch failure absorbed by the circuit "
+                    f"breaker ({self._breaker.consecutive_failures}"
+                    f"/{self._breaker.threshold} consecutive"
+                    f"{'; OPEN' if self._breaker.open else ''}): "
+                    f"{type(e).__name__}: {e}")
+        else:
+            self._admit()
+            dispatched = self._dispatch_decode()
         # lag-one processing: with fresh work in flight, leave the newest
         # event unread so the device/tunnel keeps running while the host
         # does bookkeeping; once nothing new was dispatched, flush fully
@@ -240,23 +498,80 @@ class ServingEngine:
         self.stats["iterations"] += 1
         self.stats["wall_secs"] += time.perf_counter() - t0
         self._it += 1
+        if self._pending_reports:
+            finished.update(self._pending_reports)
+            self._pending_reports.clear()
         return finished
 
-    def drain(self):
-        """Run the scheduler until every submitted request has finished;
-        returns ``{rid: np.ndarray}`` for everything completed during the
-        call."""
+    def drain(self, timeout_s=None):
+        """Run the scheduler until every submitted request has reached a
+        terminal status; returns ``{rid: output}`` for everything that
+        finished during the call (``None`` outputs for non-COMPLETED
+        terminals).  ``timeout_s`` (default: the config's
+        ``drain_timeout_s``; 0/None = no limit) bounds the wall clock —
+        past it :class:`~.slo.DrainTimeout` is raised with per-slot
+        diagnostics (slot id, request id, last dispatch age) instead of
+        spinning forever on a wedged scheduler."""
+        if timeout_s is None:
+            timeout = self.config.drain_timeout_s or None
+        else:
+            timeout = timeout_s or None      # explicit 0 = no limit
+        t0 = time.monotonic()
         results = {}
         while self._queue or self._pending is not None or self._events \
                 or self._mirror_active.any():
+            if timeout is not None and time.monotonic() - t0 > timeout:
+                raise DrainTimeout(
+                    self._drain_diagnostics(timeout,
+                                            time.monotonic() - t0))
+            if self._breaker.open and not self._breaker.allow_dispatch() \
+                    and not (self._events or self._mirror_active.any()):
+                # open breaker, nothing in flight: don't busy-spin the
+                # queue scan while waiting out the cooldown
+                time.sleep(min(
+                    0.01, self._breaker.seconds_until_half_open()))
             results.update(self.step())
+        if self._pending_reports:
+            results.update(self._pending_reports)
+            self._pending_reports.clear()
         return results
 
+    def _drain_diagnostics(self, timeout, elapsed):
+        now = time.monotonic()
+        lines = [f"drain() exceeded its {timeout:.1f}s wall-clock budget "
+                 f"({elapsed:.1f}s elapsed) with work outstanding: "
+                 f"queue={len(self._queue)}, "
+                 f"in_flight_events={len(self._events)}"]
+        for s, req in enumerate(self._slots):
+            if req is None:
+                continue
+            last = self._slot_last_dispatch.get(s)
+            age = f"{now - last:.1f}s ago" if last is not None else "never"
+            lines.append(f"  slot {s}: request {req.rid} "
+                         f"(status {req.status}, {len(req.tokens)} "
+                         f"token(s), last dispatch {age})")
+        if self._pending is not None:
+            lines.append(f"  pending prefill: request "
+                         f"{self._pending.req.rid} on slot "
+                         f"{self._pending.slot} "
+                         f"({self._pending.ci}/{self._pending.n_chunks} "
+                         f"chunks)")
+        if self._breaker.open:
+            lines.append(f"  circuit breaker OPEN "
+                         f"({self._breaker.consecutive_failures} "
+                         f"consecutive failures; last: "
+                         f"{self._breaker.last_error})")
+        return "\n".join(lines)
+
     def close(self):
-        """Return the KV workspaces (the big slot cache, the slot state
-        and the prefill lanes); a later ``step()`` reallocates them.
-        In-flight requests (if any) are aborted — only the queue
-        survives."""
+        """Retire the server: abort everything undrained (queued,
+        prefilling and in-slot requests all end ``ABORTED``), release the
+        KV workspaces, and mark the engine closed — ``submit()``/
+        ``step()`` afterwards raise.  Idempotent: every call returns the
+        same sorted list of the request ids that were undrained at the
+        first close."""
+        if self._closed:
+            return list(self._close_report)
         finished = {}
         try:
             self._process_events(finished, keep=0)
@@ -266,6 +581,15 @@ class ServingEngine:
         if finished:
             logger.warning(f"serving close(): {len(finished)} finished "
                            f"request(s) discarded unread")
+        undrained = sorted(
+            [r.rid for r in self._slots if r is not None]
+            + ([self._pending.req.rid] if self._pending is not None else [])
+            + [r.rid for r in self._queue])
+        for req in list(self._queue):
+            self._record_terminal(req, RequestStatus.ABORTED,
+                                  "engine closed with the request still "
+                                  "queued")
+        self._queue.clear()
         self._abort_in_flight("close()")
         if self._cache is not None:
             self._cache_ws.give_back(self._cache)
@@ -273,6 +597,12 @@ class ServingEngine:
         self._state = None
         self._cache_ws.release()
         self._lane_pool.release()
+        self._closed = True
+        self._close_report = undrained
+        if undrained:
+            logger.warning(f"serving close(): {len(undrained)} undrained "
+                           f"request(s) {undrained} aborted")
+        return list(self._close_report)
 
     def _abort_in_flight(self, why):
         """Drop every request past admission (its KV rows live in buffers
@@ -282,9 +612,20 @@ class ServingEngine:
         decode dispatch would leak the occupied slots forever (drain()
         then spins: nothing free to admit, nothing active to decode) and
         stale events would replay against the fresh all-inactive state."""
-        lost = [r.rid for r in self._slots if r is not None]
+        lost = []
+        for req in self._slots:
+            if req is None:
+                continue
+            lost.append(req.rid)
+            if req.status not in TERMINAL_STATUSES:
+                self._record_terminal(req, RequestStatus.ABORTED,
+                                      f"in-flight request aborted: {why}")
         if self._pending is not None:
-            lost.append(self._pending.req.rid)
+            req = self._pending.req
+            lost.append(req.rid)
+            if req.status not in TERMINAL_STATUSES:
+                self._record_terminal(req, RequestStatus.ABORTED,
+                                      f"admission aborted: {why}")
             self._lane_pool.give_back(self._pending.lane)
             self._pending = None
         self._events.clear()
@@ -316,18 +657,20 @@ class ServingEngine:
     # ------------------------------------------------------------------ #
     def warmup(self, monitor=None):
         """AOT-compile the expensive serving programs (the decode block
-        and the admission prefill chunk) against abstract arguments —
-        with the ``compile_cache`` block on, a restarted server RELOADS
-        them instead of recompiling (watch
-        ``compile_cache.stats().executable_hits``).  Returns
-        ``{program: compile_seconds}`` (0.0 = warm/store hit).
+        and the admission prefill chunk) against abstract arguments, once
+        per process, up front — so the first requests do not pay the
+        compile.  Returns ``{program: compile_seconds}`` (0.0 = this
+        process already compiled it).  The serving programs deliberately
+        bypass the persistent cache layers (see ``__init__``:
+        cross-process reloaded serving executables corrupt the slot
+        workspace), so a restarted server recompiles here rather than
+        reloading.
 
         The fused admit program deliberately compiles on first use
         instead: it takes no ``params``, so an abstract-args compile would
         pin it to single-device input shardings while its runtime inputs
         (chunk-program outputs) carry the mesh's replicated sharding —
-        first-use compilation sees the real shardings and still
-        round-trips the executable store like everything else."""
+        first-use compilation sees the real shardings."""
         eng = self.engine
         N, S, C = self.num_slots, self.cache_len, self.chunk
         dtype = eng.compute_dtype
@@ -402,17 +745,19 @@ class ServingEngine:
     def _start_prefill(self, req):
         slot = self._free.popleft()
         req.slot = slot
-        P = len(req.ids)
+        req.status = RequestStatus.PREFILLING
+        fill = req.fill_ids              # prompt + any resumed tokens
+        P = len(fill)
         n = -(-P // self.chunk)
         ids_pad = np.zeros((1, n * self.chunk), np.int32)
-        ids_pad[0, :P] = req.ids
+        ids_pad[0, :P] = fill
         lane = self._lane_pool.take(self.cache_len,
                                     self.engine.compute_dtype)
-        return _PendingPrefill(req, slot, lane, ids_pad, n)
+        return _PendingPrefill(req, slot, lane, ids_pad, n, P)
 
     def _run_prefill_chunk(self, p):
         C = self.chunk
-        P = len(p.req.ids)
+        P = p.fill_len
         local = int(min(max(P - 1 - p.ci * C, 0), C - 1))
         try:
             logits, p.lane = self.engine._run_guarded(
@@ -421,15 +766,22 @@ class ServingEngine:
                  jnp.asarray(p.ids_pad[:, p.ci * C:(p.ci + 1) * C]),
                  jnp.asarray(p.ci * C, jnp.int32),
                  jnp.asarray([local], jnp.int32)))
-        except BaseException:
+        except BaseException as e:
             # the donated lane may be dead — drop only THIS admission
             # (the decode workspace is untouched by a prefill failure)
             self._lane_pool.give_back(p.lane)
             self._free.append(int(p.slot))
             self._pending = None
+            if p.req.status not in TERMINAL_STATUSES:
+                self._record_terminal(
+                    p.req, RequestStatus.ABORTED,
+                    f"admission prefill dispatch failed: "
+                    f"{type(e).__name__}: {e}")
+                self.stats["aborted"] = self.stats.get("aborted", 0) + 1
             logger.warning(f"serving prefill failed — request "
                            f"{p.req.rid} dropped")
             raise
+        self._breaker.record_success()
         if (P - 1) // C == p.ci:
             # this chunk held the prompt's last real position — its
             # selected logits seed the first sampled token (device-side;
@@ -442,26 +794,37 @@ class ServingEngine:
     def _dispatch_admit(self, p):
         """Prefill complete: ONE fused dispatch samples the first token,
         inserts the lane and writes the slot state in-program.  The first
-        token is read lazily when the event is processed."""
+        token is read lazily when the event is processed.  A resumed
+        request (non-empty ``prefix``) admits with the REMAINING token
+        budget — its prefix already counts against ``max_new``."""
         req = p.req
+        dev_new = req.max_new - len(req.prefix)
         self._rng, sub = jax.random.split(self._rng)
         try:
+            inject.fire("serving.pre_admit")
             self._cache, self._state, first = self.engine._run_guarded(
                 self._admit_fn,
                 (self._cache, self._state, p.lane, p.sel, sub,
                  jnp.asarray(p.slot, jnp.int32),
-                 jnp.asarray(len(req.ids), jnp.int32),
-                 jnp.asarray(req.max_new, jnp.int32),
+                 jnp.asarray(p.fill_len, jnp.int32),
+                 jnp.asarray(dev_new, jnp.int32),
                  jnp.asarray(req.eos, jnp.int32)))
-        except BaseException:
+        except BaseException as e:
             # cache/state were donated — same recovery as a decode
             # failure (this admission's request is lost with them)
             self._cache_ws.give_back(self._cache)
             self._cache = None
             self._lane_pool.give_back(p.lane)
+            if req.status not in TERMINAL_STATUSES:
+                self._record_terminal(req, RequestStatus.ABORTED,
+                                      f"admit dispatch failed: "
+                                      f"{type(e).__name__}: {e}")
             self._abort_in_flight(f"admit dispatch failed "
                                   f"(request {req.rid} lost)")
             raise
+        self._breaker.record_success()
+        self._slot_last_dispatch[int(p.slot)] = time.monotonic()
+        req.status = RequestStatus.RUNNING
         self._slots[p.slot] = req
         self._events.append(("admit", req, p.slot, p.lane, first))
         self.stats["admitted"] += 1
@@ -477,6 +840,7 @@ class ServingEngine:
             return False
         self._rng, sub = jax.random.split(self._rng)
         try:
+            inject.fire("serving.pre_decode_dispatch")
             toks, self._cache, self._state = self.engine._run_guarded(
                 self._decode_fn,
                 (self.engine._params, self._cache, self._state, sub))
@@ -490,6 +854,11 @@ class ServingEngine:
             self._cache = None
             self._abort_in_flight("decode dispatch failed")
             raise
+        self._breaker.record_success()
+        now = time.monotonic()
+        for s, r in enumerate(self._slots):
+            if r is not None:
+                self._slot_last_dispatch[s] = now
         self._events.append(("decode", toks))
         self.stats["decode_calls"] += 1
         return True
@@ -511,9 +880,21 @@ class ServingEngine:
         first = int(np.asarray(first_dev))
         self.stats["sync_secs"] += time.perf_counter() - t0
         self._lane_pool.give_back(lane)
-        req.tokens = [first]
-        # mirror the admit program's activation rule
-        if (req.eos >= 0 and first == req.eos) or req.max_new == 1:
+        if req.status in TERMINAL_STATUSES:
+            # shed/cancelled while the admit event was in flight: free
+            # the slot now (the shed path left it to us), discard the
+            # token — the device lane stays a masked no-op until its
+            # next occupant's admit overwrites it
+            self._slots[slot] = None
+            self._free.append(int(slot))
+            return
+        if req.first_tok_t is None:
+            req.first_tok_t = time.monotonic()
+        req.tokens = list(req.prefix) + [first]
+        # mirror the admit program's activation rule (the device saw the
+        # REMAINING budget max_new - len(prefix))
+        dev_new = req.max_new - len(req.prefix)
+        if (req.eos >= 0 and first == req.eos) or dev_new == 1:
             self._slots[slot] = None
             self._free.append(int(slot))
             finished[req.rid] = self._finalize(req)
@@ -544,15 +925,253 @@ class ServingEngine:
 
     def _finalize(self, req):
         """The ``generate()`` output contract: ``[prompt..., tokens...]``
-        of length ``P + max_new_tokens``, eos-padded past an early stop."""
+        of length ``P + max_new_tokens``, eos-padded past an early stop.
+        For resumed requests ``tokens`` already includes the prefix, so
+        the stitched output is exactly the uninterrupted run's."""
         req.finished_it = self._it
+        req.status = RequestStatus.COMPLETED
         self.stats["completed"] += 1
         P = len(req.ids)
         pad = req.eos if req.eos >= 0 else 0
         out = np.full((P + req.max_new,), pad, np.int32)
         out[:P] = req.ids
         out[P:P + len(req.tokens)] = np.asarray(req.tokens, np.int32)
+        ttft = (req.first_tok_t - req.submit_t) \
+            if req.first_tok_t is not None else None
+        self._results[req.rid] = RequestResult(
+            rid=req.rid, status=RequestStatus.COMPLETED, output=out,
+            client_id=req.client_id, submitted_it=req.submitted_it,
+            finished_it=self._it, ttft_s=ttft)
         return out
+
+    # ------------------------------------------------------------------ #
+    # Graceful preemption: drain -> crash-atomic snapshot -> resume
+    # ------------------------------------------------------------------ #
+    def _undrained_requests(self):
+        """Every request that would be lost if the process died now:
+        in-slot (non-terminal), mid-admission, and queued — in a stable
+        order (slots, pending, queue)."""
+        reqs = [r for r in self._slots
+                if r is not None and r.status not in TERMINAL_STATUSES]
+        if self._pending is not None \
+                and self._pending.req.status not in TERMINAL_STATUSES:
+            reqs.append(self._pending.req)
+        reqs.extend(r for r in self._queue
+                    if r.status not in TERMINAL_STATUSES)
+        return reqs
+
+    def preempt(self, checkpoint_dir, drain_budget_s=None, tag=None):
+        """The SIGTERM path (``DSElasticAgent`` preemption): stop
+        admission, keep decoding the in-flight slots for up to
+        ``drain_budget_s`` seconds (default: the config's
+        ``drain_budget_s``; 0 = snapshot immediately), then snapshot
+        every undrained request — prompt, tokens generated so far,
+        remaining deadline and the scheduler RNG lane state — through the
+        crash-atomic checkpoint protocol, and retire the engine (it is
+        closed afterwards).  Returns ``(tag, snapshotted_rids,
+        finished)`` where ``finished`` holds the requests that completed
+        during the drain.  A restarted server picks the snapshot up with
+        :meth:`restore`; greedy resumed outputs are bitwise-identical to
+        an uninterrupted run."""
+        if self._closed:
+            raise RuntimeError("preempt() on a closed ServingEngine")
+        budget = self.config.drain_budget_s if drain_budget_s is None \
+            else float(drain_budget_s)
+        t0 = time.monotonic()
+        finished = {}
+        self._shed_expired()
+        # drain: decode-only iterations (no admissions) under the budget
+        while (self._mirror_active.any()
+               or any(e[0] == "admit" for e in self._events)) \
+                and time.monotonic() - t0 < budget:
+            inject.fire("serving.mid_drain")
+            try:
+                dispatched = self._dispatch_decode()
+            except Exception as e:
+                # a sick device must not block the snapshot: the failed
+                # dispatch aborted the in-flight slots (their requests
+                # are ABORTED with the reason); snapshot what remains
+                logger.error(f"serving preempt: drain dispatch failed "
+                             f"({type(e).__name__}: {e}) — snapshotting "
+                             f"the queue")
+                break
+            self._process_events(finished, keep=1 if dispatched else 0)
+        try:
+            self._process_events(finished, keep=0)
+        except Exception as e:
+            logger.warning(f"serving preempt: discarding unreadable "
+                           f"in-flight events ({type(e).__name__}: {e})")
+            self._abort_in_flight("preempt event flush failed")
+        drain_secs = time.monotonic() - t0
+        self._shed_expired()                 # don't snapshot expired work
+        undrained = self._undrained_requests()
+        tag = self.snapshot(checkpoint_dir, tag=tag)
+        for req in undrained:
+            req.status = RequestStatus.PREEMPTED
+        snapped = [r.rid for r in undrained]
+        # retire the engine without ABORTED accounting: the snapshotted
+        # requests are not lost, they resume elsewhere
+        if self._pending is not None:
+            self._lane_pool.give_back(self._pending.lane)
+            self._pending = None
+        self._queue.clear()
+        self._events.clear()
+        self._slots = [None] * self.num_slots
+        self._free = deque(range(self.num_slots))
+        self._mirror_active[:] = False
+        if self._cache is not None:
+            self._cache_ws.give_back(self._cache)
+            self._cache = None
+        self._state = None
+        self._cache_ws.release()
+        self._lane_pool.release()
+        self._closed = True
+        self._close_report = sorted(snapped)
+        self.stats["drain_secs"] = \
+            self.stats.get("drain_secs", 0.0) + drain_secs
+        self.stats["preempt_snapshotted"] = len(snapped)
+        if self._pending_reports:
+            finished.update(self._pending_reports)
+            self._pending_reports.clear()
+        logger.warning(f"serving preempt: drained {drain_secs:.2f}s, "
+                       f"{len(finished)} request(s) finished in drain, "
+                       f"{len(snapped)} snapshotted to {tag!r}")
+        return tag, snapped, finished
+
+    def snapshot(self, checkpoint_dir, tag=None):
+        """Crash-atomically publish the undrained requests (and the
+        scheduler RNG lane state) under ``checkpoint_dir`` — the
+        serving analog of a training checkpoint (staging dir, manifest
+        with checksums, fsync, atomic rename, ``latest`` swap; see
+        ``inference/serving/snapshot.py``).  Pure write: the engine's
+        bookkeeping is untouched.  Returns the tag."""
+        from deepspeed_tpu.inference.serving.snapshot import save_snapshot
+        self._snap_seq += 1
+        tag = tag or f"serving_{self._snap_seq}"
+        import json
+        now = time.monotonic()
+        reqs = []
+        for r in self._undrained_requests():
+            cid = r.client_id
+            try:
+                json.dumps(cid)
+            except (TypeError, ValueError):
+                # a non-JSON client_id must never cost the snapshot (and
+                # with it every undrained request) on the SIGTERM path
+                logger.warning(
+                    f"serving snapshot: request {r.rid} client_id "
+                    f"{type(cid).__name__} is not JSON-serializable — "
+                    f"stored as str()")
+                cid = str(cid)
+            reqs.append({
+                "rid": int(r.rid),
+                "client_id": cid,
+                "prompt": [int(t) for t in r.ids],
+                # tokens generated so far (a queued resumed request has
+                # produced none this incarnation — carry its prefix)
+                "tokens": [int(t) for t in (r.tokens or r.prefix)],
+                "max_new": int(r.max_new),
+                "eos": int(r.eos),
+                "deadline_remaining_s":
+                    None if r.deadline is None else r.deadline - now,
+                "submitted_it": int(r.submitted_it),
+            })
+        fcfg = getattr(self.engine._config, "fault", None)
+        state = {
+            "seq": int(self._snap_seq),
+            "iteration": int(self._it),
+            "next_rid": int(self._next_rid),
+            "rng": np.asarray(
+                jax.random.key_data(self._rng)).ravel().tolist(),
+            "requests": reqs,
+        }
+        return save_snapshot(
+            checkpoint_dir, tag, state,
+            checksum=getattr(fcfg, "checksum", None) or "sha256")
+
+    def restore(self, checkpoint_dir):
+        """Resume the newest valid snapshot's requests into this server's
+        queue, keeping their original request ids, client ids and
+        remaining deadlines; the RNG lane state is restored too.  Each
+        resumed request re-prefills ``prompt + generated-so-far`` through
+        the ordinary admission path and decodes only its remaining budget
+        — under greedy decoding the stitched output is bitwise what the
+        uninterrupted run would have produced.  Returns the restored
+        request ids (empty when there is nothing to resume)."""
+        from deepspeed_tpu.inference.serving.snapshot import \
+            load_newest_snapshot
+        tag, state = load_newest_snapshot(checkpoint_dir)
+        if state is None:
+            return []
+        self._snap_seq = max(self._snap_seq, int(state.get("seq", 0)))
+        if state.get("rng"):
+            self._rng = jax.random.wrap_key_data(
+                jnp.asarray(state["rng"], jnp.uint32))
+        now = time.monotonic()
+        rids = []
+        for r in state.get("requests", []):
+            if int(r["rid"]) in self._requests:
+                raise ValueError(
+                    f"restore(): request id {r['rid']} already exists on "
+                    f"this server — call restore() before submitting new "
+                    f"work (snapshotted ids are preserved verbatim)")
+            ids = np.asarray(r["prompt"], np.int32)
+            prefix = [int(t) for t in r.get("tokens", [])]
+            max_new, eos = int(r["max_new"]), int(r["eos"])
+            if len(prefix) >= max_new \
+                    or (eos >= 0 and eos in prefix):
+                # defensive: a finished request has nothing to resume
+                continue
+            deadline = None
+            if r.get("deadline_remaining_s") is not None:
+                deadline = now + float(r["deadline_remaining_s"])
+            req = ServeRequest(
+                int(r["rid"]), ids, max_new, eos, submitted_it=self._it,
+                deadline=deadline, client_id=r.get("client_id"),
+                prefix=prefix, submit_t=now)
+            # every restored request must pass submit()'s capacity check
+            # against THIS server's lane config (the snapshot may come
+            # from a server with a larger max_cache_len / smaller chunk
+            # — admitting an oversized request would stream prefill
+            # chunks past the lane's end)
+            P = len(ids)
+            if max(P + max_new,
+                   -(-P // self.chunk) * self.chunk) > self.cache_len:
+                self._requests[req.rid] = req
+                self._record_terminal(
+                    req, RequestStatus.ABORTED,
+                    f"restored request needs more than the "
+                    f"{self.cache_len} cache positions this server's "
+                    f"lanes hold (prompt {P} + new {max_new}) — raise "
+                    f"serving.max_cache_len to resume it")
+                logger.warning(f"serving restore: request {req.rid} does "
+                               f"not fit this server's lanes — ABORTED")
+                self._next_rid = max(self._next_rid, req.rid + 1)
+                continue
+            # the resumed fill (prompt + prefix) must still fit a lane;
+            # when the chunk-padded tail would overflow, drop the prefix
+            # and re-decode from scratch — still bitwise-correct, just
+            # wasteful
+            fill = P + len(prefix)
+            padded = -(-fill // self.chunk) * self.chunk
+            if prefix and max(fill + (max_new - len(prefix)),
+                              padded) > self.cache_len:
+                logger.warning(
+                    f"serving restore: request {req.rid} prefix "
+                    f"({len(prefix)} tokens) does not fit its lane "
+                    f"chunk-padded — re-decoding from the prompt")
+                req.prefix = []
+            self._queue.append(req)
+            self._requests[req.rid] = req
+            self._next_rid = max(self._next_rid, req.rid + 1)
+            rids.append(req.rid)
+        self._next_rid = max(self._next_rid,
+                             int(state.get("next_rid", 0)))
+        self.stats["resumed"] += len(rids)
+        if rids:
+            log_dist(f"serving restore[{tag}]: resumed {len(rids)} "
+                     f"request(s) {rids}", ranks=[0])
+        return rids
 
     # ------------------------------------------------------------------ #
     # Plumbing
@@ -582,4 +1201,9 @@ class ServingEngine:
              self.stats["prefill_tokens"]
              / max(self.stats["decode_tokens"], 1), self._it),
             ("Serving/completed", self.stats["completed"], self._it),
+            ("Serving/shed", self.stats["shed"], self._it),
+            ("Serving/cancelled", self.stats["cancelled"], self._it),
+            ("Serving/aborted", self.stats.get("aborted", 0), self._it),
+            ("Serving/breaker_open",
+             1.0 if self._breaker.open else 0.0, self._it),
         ])

@@ -1,0 +1,155 @@
+"""Serving SLO primitives — typed request lifecycle, admission
+backpressure, and the dispatch circuit breaker (``docs/serving.md``,
+"Robustness & SLOs").
+
+A production scheduler must be able to REFUSE and RETIRE work, not just
+admit it (Orca's iteration-level scheduling assumes exactly this): every
+request ends in one of four typed terminal statuses, the queue is
+bounded, and a sick device trips a breaker instead of being hammered
+with doomed dispatches.  Everything here is host bookkeeping — SLO state
+never touches a compiled program (the one-decode-executable invariant).
+"""
+
+import time
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+
+class RequestStatus:
+    """Request lifecycle states.  Terminal outcomes (the typed status a
+    client sees): ``COMPLETED`` | ``SHED_DEADLINE`` | ``CANCELLED`` |
+    ``ABORTED``.  ``PREEMPTED`` marks a request snapshotted for resume on
+    a graceful drain — not terminal: a restarted server finishes it."""
+    QUEUED = "QUEUED"
+    PREFILLING = "PREFILLING"
+    RUNNING = "RUNNING"
+    COMPLETED = "COMPLETED"
+    SHED_DEADLINE = "SHED_DEADLINE"
+    CANCELLED = "CANCELLED"
+    ABORTED = "ABORTED"
+    PREEMPTED = "PREEMPTED"
+
+
+TERMINAL_STATUSES = frozenset({
+    RequestStatus.COMPLETED, RequestStatus.SHED_DEADLINE,
+    RequestStatus.CANCELLED, RequestStatus.ABORTED,
+})
+
+
+@dataclass
+class RequestResult:
+    """Terminal record for one request (``ServingEngine.result(rid)``).
+
+    ``output`` follows the ``generate()`` contract ``[prompt...,
+    generated...]`` for ``COMPLETED`` requests and is ``None`` for every
+    other terminal status; ``detail`` carries the human-readable reason
+    (which deadline, which dispatch failure, ...).  ``ttft_s`` is
+    submit-to-first-token wall time (``None`` when the request never
+    produced a token)."""
+    rid: int
+    status: str
+    output: Optional[np.ndarray] = None
+    detail: str = ""
+    client_id: Any = None
+    submitted_it: int = 0
+    finished_it: Optional[int] = None
+    ttft_s: Optional[float] = None
+
+
+class QueueFull(RuntimeError):
+    """``submit()`` backpressure: the bounded queue is at
+    ``max_queue_depth`` and the policy is ``reject`` (or ``block`` could
+    not make progress)."""
+
+
+class CircuitOpen(RuntimeError):
+    """``submit()`` refused because the dispatch circuit breaker is open
+    — the device failed ``breaker_threshold`` consecutive dispatches and
+    admission is suspended until the cooldown's half-open probe
+    succeeds."""
+
+
+class DrainTimeout(RuntimeError):
+    """``drain()`` exceeded ``drain_timeout_s`` without retiring the
+    remaining work; the message carries per-slot diagnostics (slot id,
+    request id, last dispatch age)."""
+
+
+class CircuitBreaker:
+    """Consecutive-dispatch-failure breaker for the serving engine.
+
+    ``threshold <= 0`` disables it entirely (seed behavior: dispatch
+    failures propagate to the caller).  When enabled, every failed
+    decode/admit/prefill dispatch is absorbed and counted; ``threshold``
+    consecutive failures OPEN the breaker — new work is rejected with a
+    reason (:class:`CircuitOpen`) and no dispatches run until
+    ``cooldown_s`` elapses, when ONE half-open probe dispatch is allowed
+    through: success closes the breaker, failure re-opens it (and
+    re-arms the cooldown)."""
+
+    def __init__(self, threshold, cooldown_s):
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.consecutive_failures = 0
+        self.trips = 0
+        self._opened_at = None           # monotonic; None = closed
+        self.last_error = ""
+
+    @property
+    def enabled(self):
+        return self.threshold > 0
+
+    @property
+    def open(self):
+        return self._opened_at is not None
+
+    def allow_dispatch(self):
+        """True when dispatching is permitted: closed, or half-open (the
+        cooldown elapsed — the next dispatch is the probe)."""
+        if self._opened_at is None:
+            return True
+        return time.monotonic() - self._opened_at >= self.cooldown_s
+
+    def seconds_until_half_open(self):
+        if self._opened_at is None:
+            return 0.0
+        return max(0.0,
+                   self.cooldown_s - (time.monotonic() - self._opened_at))
+
+    def record_success(self):
+        self.consecutive_failures = 0
+        self._opened_at = None
+
+    def record_failure(self, exc):
+        self.consecutive_failures += 1
+        self.last_error = f"{type(exc).__name__}: {exc}"
+        if self._opened_at is not None:
+            # a failed half-open probe: re-open and re-arm the cooldown
+            self._opened_at = time.monotonic()
+            self.trips += 1
+        elif self.consecutive_failures >= self.threshold:
+            self._opened_at = time.monotonic()
+            self.trips += 1
+
+    def check_submit(self):
+        """Raise :class:`CircuitOpen` (reject-with-reason) while open.
+        Once the cooldown has elapsed (half-open) submissions are
+        admitted again — the next dispatch is the probe.  Without this,
+        a breaker that opened with an EMPTY queue would lock the server
+        out of ``submit()`` forever: the probe needs work, and work
+        could never arrive."""
+        if not self.enabled or self._opened_at is None:
+            return
+        if self.allow_dispatch():
+            return
+        raise CircuitOpen(
+            f"serving circuit breaker OPEN after "
+            f"{self.consecutive_failures} consecutive dispatch failures "
+            f"(last: {self.last_error}); half-open probe in "
+            f"{self.seconds_until_half_open():.1f}s")
+
+
+__all__ = ["RequestStatus", "TERMINAL_STATUSES", "RequestResult",
+           "QueueFull", "CircuitOpen", "DrainTimeout", "CircuitBreaker"]

@@ -5,8 +5,9 @@ The fixed-shape contract (``docs/serving.md``): the KV workspace holds
 piece of per-slot occupancy state (last token, write position, live flag,
 steps remaining, eos id) is a TRACED argument — so admissions, EOS
 retirements and request churn never change a program shape, and exactly ONE
-decode-step executable serves the whole server lifetime (persisted via the
-compile cache, reloaded across restarts).
+decode-step executable serves the whole server lifetime (compiled once per
+process — the serving programs bypass the persistent caches, see
+``ServingEngine.__init__``).
 
 Two programs:
 

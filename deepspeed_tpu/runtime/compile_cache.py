@@ -31,6 +31,7 @@ Delete the cache directory to reclaim space — both layers rebuild on the
 next cold run.
 """
 
+import contextlib
 import hashlib
 import json
 import os
@@ -152,6 +153,51 @@ def configure_persistent_cache(cache_dir=None, min_compile_time_secs=None):
     return cache_dir
 
 
+def _reset_jax_cache_state():
+    """Drop jax's initialized-once compilation-cache module state so the
+    next compile re-reads the live config.  jax 0.4.x caches the decision
+    AND the cache object in module globals (``_cache_checked`` /
+    ``_cache``), so flipping ``jax_compilation_cache_dir`` alone does
+    NOT detach an already-used cache."""
+    try:
+        from jax._src import compilation_cache as jcc
+        jcc.reset_cache()
+        return True
+    except Exception as e:                       # API drift: fail open
+        logger.warning(f"compile_cache: could not reset jax's "
+                       f"compilation-cache state ({e}) — persistent-cache "
+                       f"suspension is best-effort only")
+        return False
+
+
+@contextlib.contextmanager
+def suspended_persistent_cache():
+    """Temporarily detach the process from the XLA persistent cache for
+    the compiles inside the block (no reads, no writes).  For programs
+    whose RELOADED form is unsafe to reuse across processes — the
+    serving slot programs chain one donated workspace across three
+    executables, and reloading ANY of them from either cache layer in a
+    fresh process nondeterministically corrupts the slot cache or
+    segfaults (bisected with the serving kill-harness driver; the train
+    and whole-batch generate paths show no such failures and keep both
+    layers).  Compiles are synchronous on the calling thread, so the
+    process-global config flip is safe."""
+    import jax
+    prev = jax.config.jax_compilation_cache_dir
+    if prev is None:
+        yield
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        _reset_jax_cache_state()
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+        # re-attach lazily: the next ordinary compile re-initializes
+        # from the restored config
+        _reset_jax_cache_state()
+
+
 def deconfigure_persistent_cache():
     """Undo :func:`configure_persistent_cache` — for scripts/harnesses that
     must detach the process from a temporary cache directory before it is
@@ -160,6 +206,9 @@ def deconfigure_persistent_cache():
     global _configured_dir
     import jax
     jax.config.update("jax_compilation_cache_dir", None)
+    # the config flip alone does not detach an already-initialized cache
+    # (jax caches the decision in module globals) — reset it too
+    _reset_jax_cache_state()
     _configured_dir = None
 
 

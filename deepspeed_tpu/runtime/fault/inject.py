@@ -26,6 +26,17 @@ point                                 seam
                                       (``sigterm``-at-step-K, ``hang``)
 ``infer.executable_load``             AOT executable load/compile in the
                                       inference engine
+``serving.pre_admit``                 before the serving engine's fused
+                                      admit dispatch (slot reserved, lane
+                                      prefilled, state not yet written)
+``serving.pre_decode_dispatch``       before each serving decode-block
+                                      dispatch
+``serving.mid_drain``                 every iteration of the graceful
+                                      preemption drain loop (kills here
+                                      land BEFORE the snapshot publish)
+``serving.sigterm_at_iter``           top of every serving scheduler
+                                      iteration (``sigterm``-at-iter-K:
+                                      the graceful-preemption proof)
 ====================================  ====================================
 
 Arm points programmatically (:func:`configure_injection`) or via the
@@ -74,6 +85,10 @@ INJECTION_POINTS = (
     "ckpt.before_latest_swap",
     "train.step_begin",
     "infer.executable_load",
+    "serving.pre_admit",
+    "serving.pre_decode_dispatch",
+    "serving.mid_drain",
+    "serving.sigterm_at_iter",
 )
 
 

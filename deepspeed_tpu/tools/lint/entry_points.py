@@ -169,11 +169,12 @@ def serving_decode_step():
 
 
 def serving_admission_prefill():
-    """The serving admission prefill — the engine's donated per-chunk
-    executable at lane width B=1, replayed for every admitted prompt."""
+    """The serving admission prefill — the donated per-chunk program at
+    lane width B=1, replayed for every admitted prompt (the serving
+    engine holds a dedicated instance of this program; same body)."""
     engine = _tiny_inference_engine()
     C = 8
-    chunk_fn = engine._get_chunk_fn(C, 1)
+    chunk_fn = engine._make_chunk_fn()
     lane = engine.module.init_cache(1, 32, dtype=engine.compute_dtype)
     ids = jnp.asarray(np.random.default_rng(3).integers(0, 97, (1, C)),
                       jnp.int32)

@@ -273,11 +273,14 @@ def test_serving_monitor_events(served_engine):
     assert occ and max(occ) <= 1.0 and min(occ) >= 0.0
 
 
-def test_serving_decode_program_reloads_across_restarts(tmp_path):
-    """Compile-cache acceptance: a second server (fresh engine — a
-    restarted process in spirit) RELOADS the serving executables from the
-    store instead of recompiling, proven by the framework's cache-hit
-    counters."""
+def test_serving_programs_bypass_persistent_cache_across_restarts(tmp_path):
+    """The serving slot programs must NOT round-trip either persistent
+    cache layer: cross-process reloaded serving executables corrupt the
+    donated slot workspace (wrong tokens / cross-lane mixing / segfaults
+    — bisected with the kill-harness driver, see
+    ServingEngine.__init__).  A restarted server recompiles its three
+    serving programs — zero store saves/hits — and serves outputs
+    bitwise-identical to the first server's."""
     from deepspeed_tpu.runtime import compile_cache as cc
 
     prev_dir = jax.config.jax_compilation_cache_dir
@@ -307,20 +310,21 @@ def test_serving_decode_program_reloads_across_restarts(tmp_path):
         s0 = cc.stats().snapshot()
         report1, out1 = run_server()
         s1 = cc.stats().snapshot()
-        # cold server: the decode program really compiled (and was saved)
+        # the decode program really compiled — and NOTHING serving was
+        # persisted to the executable store
         assert any(k.startswith("serving_decode") for k in report1)
-        # three serving programs persisted cold: the prefill chunk + the
-        # decode block (warmup) and the fused admit (first use)
-        assert s1["executable_saves"] - s0["executable_saves"] >= 3
+        assert s1["executable_saves"] == s0["executable_saves"]
 
         report2, out2 = run_server()
         s2 = cc.stats().snapshot()
-        # warm server: every serving program reloads — zero compile time
-        # reported, hit counters advance, outputs identical
-        assert report2 and all(dt == 0.0 for dt in report2.values()), report2
-        assert s2["executable_hits"] - s1["executable_hits"] >= 3
+        # restarted server: compiles again (a fresh report, no store
+        # traffic), outputs bitwise-identical
+        assert any(k.startswith("serving_decode") for k in report2)
         assert s2["executable_saves"] == s1["executable_saves"]
+        assert s2["executable_hits"] == s1["executable_hits"]
         np.testing.assert_array_equal(out1, out2)
+        # within one server lifetime nothing recompiles: warmup again is
+        # a no-op (0.0 = already compiled in this process)
     finally:
         jax.config.update("jax_compilation_cache_dir", prev_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
@@ -375,14 +379,26 @@ def test_serving_decode_failure_recovers(served_engine):
 
 
 def test_serving_close_releases_and_recovers(served_engine):
+    """close() retires the server: workspaces released, undrained request
+    ids reported (idempotently), submit() afterwards raises — a fresh
+    serve() on the same engine reproduces the outputs bitwise."""
     eng = served_engine
     rng = np.random.default_rng(21)
     p = rng.integers(1, 97, (10,)).astype(np.int32)
     srv = eng.serve()
     r1 = srv.submit(p, max_new_tokens=4)
     out1 = srv.drain()[r1]
-    srv.close()
+    q = srv.submit(p, max_new_tokens=4)        # left undrained on purpose
+    undrained = srv.close()
     assert srv._cache is None
-    r2 = srv.submit(p, max_new_tokens=4)       # reallocates on next step
-    out2 = srv.drain()[r2]
+    assert undrained == [q]
+    assert srv.result(q).status == "ABORTED"
+    # idempotent: a second close() is a no-op reporting the same ids
+    assert srv.close() == [q]
+    with pytest.raises(RuntimeError, match="closed ServingEngine"):
+        srv.submit(p, max_new_tokens=4)
+    # a fresh server on the same engine serves identically
+    srv2 = eng.serve()
+    r2 = srv2.submit(p, max_new_tokens=4)
+    out2 = srv2.drain()[r2]
     np.testing.assert_array_equal(out1, out2)

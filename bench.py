@@ -32,7 +32,11 @@ Phases run CHEAP-FIRST under per-phase wall-clock budgets
 skipped-and-recorded (no fallback retry — a safe config fixes an OOM, not
 slowness; ``BENCH_RETRY_ON_TIMEOUT=1`` re-enables it), and an optional
 ``BENCH_SUITE_BUDGET`` skips whatever the total budget can no longer
-afford.  A crashed phase is retried ONCE with a safe config (remat on /
+afford.  Under a suite budget, phase ORDER rotates round-robin across
+rounds by staleness (``_phase_order``, reading the ``BENCH_r*.json``
+trail): whatever starved last round runs first this round, so every
+phase is measured every few rounds instead of the same leading k forever
+(the round-5 blackout: 3/10 phases, five rounds running).  A crashed phase is retried ONCE with a safe config (remat on /
 smaller batch, recorded as ``"fallback": true``) and a double failure
 records an ``error`` field instead of killing the run.  Results accumulate
 TWO ways as phases complete: the raw phase map in ``.bench_partial.json``
@@ -364,9 +368,21 @@ def decode_bench(model_name="opt-1.3b", *, batch_size=16, prompt=256,
                      decode_int8_matmuls=mxu_int8)
     model = Transformer(cfg)
     quant = {"enabled": True, "bits": 8, "per_channel": True} if int8 else {}
+    # Long prompts must run the REAL chunked-prefill pipeline.  The r04
+    # 4k phase's "fallback": true was the "auto" chunk policy silently
+    # declining chunking (the Pallas chunk kernel is gated off on some
+    # backends), which dropped the 3968-token prompt onto the one-pass
+    # path — its dense-attention fallback materializes [B, H, S, S] fp32
+    # scores (~32 GB at bs16 x 4k) and OOMs, and only the bs8 retry fit.
+    # Pinning the chunk size forces the split per-chunk pipeline (dense
+    # per-chunk transient is only [B, H, C, S]); prefill_plan records
+    # which pipeline ran and why, either way.
+    chunk_cfg = 512 if prompt >= 1024 else "auto"
     eng = InferenceEngine(model, DeepSpeedInferenceConfig(
-        dtype="bfloat16", quant=quant, compile_cache=_cc_block()))
+        dtype="bfloat16", quant=quant, compile_cache=_cc_block(),
+        prefill_chunk_size=chunk_cfg))
     eng.init_params()
+    plan_mode, plan_chunk, plan_why = eng.prefill_plan(batch_size, prompt)
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch_size, prompt)).astype(np.int32)
 
@@ -426,6 +442,10 @@ def decode_bench(model_name="opt-1.3b", *, batch_size=16, prompt=256,
         "prompt_len": prompt,
         "gen_len": gen,
         "e2e_time_s": round(dt_full, 3),
+        # which prefill pipeline generate() took and why — the condition
+        # behind the old 4k "fallback": true is visible in every record
+        "prefill_plan": {"mode": plan_mode, "chunk": plan_chunk,
+                         "reason": plan_why},
     }
     if hbm_util_meas:
         result["hbm_utilization_vs_measured"] = round(hbm_util_meas, 3)
@@ -629,6 +649,128 @@ def serving_overload_bench(model_name="opt-1.3b", *, num_slots=8,
         "decode_executables_per_server": [
             sum(1 for sig in eng._aot if sig and sig[0] == id(s._decode_fn))
             for s in (srv, srv2)],
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def serving_paged_bench(model_name="opt-1.3b", *, slots_list=(96, 128, 192),
+                        page_size=64, pool_fraction=0.75, decode_block=8,
+                        prefill_chunk=128, prefix_requests=24,
+                        prefix_len=512):
+    """Paged-KV serving (``inference/serving/paging.py``, ``docs/serving.md``
+    "Paged KV cache") at the throughput serving points where the
+    monolithic per-slot lanes collapsed (r04: int8-KV decode fell 8,673 →
+    1,193 tok/s/chip between bs96 and bs128 as ``num_slots × cache_len``
+    HBM crossed the chip).  Per concurrency level: ``num_slots`` paged
+    int8-KV slots over a pool sized at ``pool_fraction`` of worst case
+    (pages back ACTUAL request lengths; pressure degrades into admission
+    stalls, never an allocation cliff), recording useful tok/s/chip,
+    page-pool utilization, and admission stalls.  Plus a shared-prefix
+    workload: ``prefix_requests`` prompts behind one ``prefix_len``-token
+    system prompt — the prefix prefills ONCE (copy-on-write page sharing),
+    every later admission hits the prefix index."""
+    import jax
+    from deepspeed_tpu.models.opt import opt_config
+    from deepspeed_tpu.models.transformer import Transformer
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+
+    cache_len = 384                         # prompts <= 256, new <= 128
+    cfg = opt_config(model_name, max_seq_len=max(cache_len, prefix_len + 256),
+                     dtype="bfloat16", scan_layers=False, kv_cache_quant=True)
+    model = Transformer(cfg)
+    quant = {"enabled": True, "bits": 8, "per_channel": True}
+    eng = InferenceEngine(model, DeepSpeedInferenceConfig(
+        dtype="bfloat16", quant=quant, compile_cache=_cc_block(),
+        serving={"enabled": True, "paged": True, "page_size": page_size,
+                 "max_cache_len": cache_len, "prefill_chunk": prefill_chunk,
+                 "prefill_token_budget": 256, "decode_block": decode_block}))
+    eng.init_params()
+    rng = np.random.default_rng(0)
+    n_dev = jax.device_count()
+    per_bs = {}
+    for bs in slots_list:
+        n_requests = 2 * bs                 # slots churn at least once
+        prompt_lens = rng.choice([64, 96, 128, 192, 256], n_requests)
+        new_lens = rng.choice([16, 32, 64, 128], n_requests)
+        prompts = [rng.integers(0, cfg.vocab_size, (int(p),))
+                   .astype(np.int32) for p in prompt_lens]
+        worst = bs * (-(-cache_len // page_size))
+        num_pages = max(2, int(pool_fraction * worst)) + 1
+        srv = eng.serve(num_slots=bs, num_pages=num_pages)
+        srv.warmup()
+        util_peak = 0.0
+
+        def run(srv):
+            nonlocal util_peak
+            t0 = time.perf_counter()
+            for p, n in zip(prompts, new_lens):
+                srv.submit(p, max_new_tokens=int(n))
+            while srv.queue_depth or srv.in_flight or srv.active_slots:
+                srv.step()
+                util_peak = max(util_peak, srv.page_pool_utilization)
+            return time.perf_counter() - t0
+
+        run(srv)                            # compile + warm
+        stalls0 = srv.stats["admission_stalls"]
+        util_peak = 0.0
+        dt = run(srv)
+        useful = int(np.sum(new_lens))
+        per_bs[str(bs)] = {
+            "num_slots": bs,
+            "n_requests": n_requests,
+            "num_pages": num_pages,
+            "pool_fraction_of_worst_case": pool_fraction,
+            "tokens_per_sec_chip": round(useful / dt / n_dev, 1),
+            "page_pool_util_peak": round(util_peak, 3),
+            "admission_stalls": srv.stats["admission_stalls"] - stalls0,
+            "time_s": round(dt, 3),
+        }
+        srv.close()
+
+    # shared-prefix workload: one system prompt, divergent user tails —
+    # the prefix prefills exactly once; hit rate counts the rest
+    pre = rng.integers(0, cfg.vocab_size, (prefix_len,)).astype(np.int32)
+    tails = [rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+             for _ in range(prefix_requests)]
+    # lanes must hold the CHUNK-PADDED prompt (submit's capacity check):
+    # ceil(528 / 128) * 128 = 640 positions
+    pc_len = prefix_len + 2 * prefill_chunk
+    srv = eng.serve(num_slots=8, max_cache_len=pc_len)
+    t0 = time.perf_counter()
+    for t in tails:
+        srv.submit(np.concatenate([pre, t]), max_new_tokens=32)
+    srv.drain()
+    dt_prefix = time.perf_counter() - t0
+    prefix = {
+        "requests": prefix_requests,
+        "prefix_len": prefix_len,
+        "prefix_hits": srv.stats["prefix_hits"],
+        "prefix_hit_rate": round(srv.prefix_hit_rate, 3),
+        "prefix_tokens_reused": srv.stats["prefix_tokens_reused"],
+        "prefill_tokens": srv.stats["prefill_tokens"],
+        # what the same workload costs with no sharing: every request
+        # prefills its full chunk-padded prompt
+        "prefill_tokens_without_sharing":
+            prefix_requests * (-(-(prefix_len + 16) // prefill_chunk))
+            * prefill_chunk,
+        "time_s": round(dt_prefix, 3),
+    }
+    srv.close()
+    r128 = per_bs.get("128", {})
+    return {
+        "model": model_name,
+        "weights": "int8-per-channel",
+        "kv_cache": "int8",
+        "page_size": page_size,
+        "decode_block": decode_block,
+        "per_bs": per_bs,
+        "prefix_sharing": prefix,
+        # the acceptance anchor: r04's bs128 monolithic int8-KV decode
+        # collapsed to 1,193 tok/s/chip (HBM util 0.58 -> 0.075)
+        "vs_r04_bs128_decode": round(
+            r128["tokens_per_sec_chip"] / 1193.0, 2)
+        if r128.get("tokens_per_sec_chip") else None,
         "platform": jax.devices()[0].platform,
     }
 
@@ -959,6 +1101,15 @@ PHASES = [
      lambda fb: serving_overload_bench("opt-1.3b",
                                        num_slots=4 if fb else 8,
                                        burst_factor=3 if fb else 4)),
+    # paged-KV serving at the bs96/128/192 points where the monolithic
+    # lanes collapsed (r04), plus the shared-prefix prefill-once story —
+    # after the cheap serving phases (it compiles one paged decode
+    # program per concurrency level; see PHASE_TIMEOUT_SCALE)
+    ("serving_paged", "serving_paged",
+     lambda fb: serving_paged_bench("opt-1.3b",
+                                    slots_list=(48, 64) if fb
+                                    else (96, 128, 192),
+                                    prefix_requests=12 if fb else 24)),
     ("generation_int8", "decode_int8",
      lambda fb: decode_bench("opt-1.3b", int8=True,
                              batch_size=8 if fb else 16)),
@@ -981,9 +1132,12 @@ PHASES = [
     ("generation_int8_kv_bs128", "decode_int8_kv_bs128",
      lambda fb: decode_bench("opt-1.3b", int8=True, kv_int8=True,
                              batch_size=64 if fb else 128, gen=128)),
-    # long-cache point: 4k-position KV cache (prompt 3968 + gen 128),
-    # split chunked prefill + fused-write decode — OOM'd outright at bs16
-    # before round 5
+    # long-cache point: 4k-position KV cache (prompt 3968 + gen 128).
+    # r04 only completed as "fallback": true (bs8) because the "auto"
+    # chunk policy dropped the 4k prompt onto the one-pass dense path
+    # (~32 GB of fp32 scores at bs16); decode_bench now pins the chunk
+    # size for prompts >= 1024 so the primary bs16 attempt runs the real
+    # chunked-prefill pipeline, and records prefill_plan either way
     ("generation_int8_kv_4k", "decode_int8_kv_4k",
      lambda fb: decode_bench("opt-1.3b", int8=True, kv_int8=True,
                              batch_size=8 if fb else 16,
@@ -1013,8 +1167,69 @@ PHASE_TIMEOUT_SCALE = {
     "sft_2.7b": 4.0,
     "long_context": 2.0,
     "hybrid": 2.0,
+    # three paged decode programs (one per concurrency level) + the
+    # prefix server's — all opted out of the persistent caches (the PR 5
+    # reload-corruption class), so every run compiles them cold
+    "serving_paged": 2.0,
     "offload": 1.5,
 }
+
+
+# --------------------------------------------------------------------- #
+# Round-robin phase fairness across bench ROUNDS (the r05 blackout:
+# under BENCH_SUITE_BUDGET a FIXED cheap-first order measured the same
+# leading phases every round and starved the other 7 forever — rc=124
+# with 3/10 phases, five rounds running).
+# --------------------------------------------------------------------- #
+
+def _round_trail():
+    """Previous rounds' final records (``BENCH_r*.json`` next to this
+    file / in ``BENCH_OUT_DIR``), oldest first — the driver publishes one
+    per round.  Unreadable files are skipped (a partial record must never
+    wedge scheduling)."""
+    import glob
+    recs = []
+    for p in sorted(glob.glob(os.path.join(_out_dir(), "BENCH_r*.json"))):
+        try:
+            with open(p) as f:
+                recs.append(json.load(f))
+        except (OSError, ValueError):
+            continue
+    return recs
+
+
+def _phase_measured(rec, key):
+    """True when ``rec`` holds a COMPLETED measurement for the phase —
+    skipped / timed-out / errored entries don't count (that phase is
+    still starving)."""
+    k = "north_star" if key == "__headline__" else key
+    ph = rec.get(k)
+    return isinstance(ph, dict) and ph \
+        and not any(t in ph for t in ("skipped", "timeout", "error"))
+
+
+def _phase_order(phases):
+    """Order phases by STALENESS — how many rounds ago the BENCH_r* trail
+    last holds a completed measurement (never measured = older than the
+    whole trail) — most starved first, ties in registry (cheap-first)
+    order.  With a suite budget that fits k of the n phases, every phase
+    is measured at least every ceil(n/k) rounds instead of the same k
+    forever, and because the incremental record is rewritten after every
+    phase, each round's partial record stays a valid final-format record
+    of whatever its budget afforded.  Calibration is pinned first: later
+    phases anchor their roofline math to its measured peaks."""
+    trail = _round_trail()
+
+    def staleness(key):
+        for age, rec in enumerate(reversed(trail), 1):
+            if _phase_measured(rec, key):
+                return age
+        return len(trail) + 1
+
+    index = {p[0]: i for i, p in enumerate(phases)}
+    rest = sorted((p for p in phases if p[1] != "calibrate"),
+                  key=lambda p: (-staleness(p[0]), index[p[0]]))
+    return [p for p in phases if p[1] == "calibrate"] + rest
 
 
 def run_phase(name, fallback, out_path):
@@ -1175,9 +1390,15 @@ def main():
     suite_t0 = time.perf_counter()
 
     phases = PHASES
+    if suite_budget:
+        # a bounded round cannot fit every phase — rotate by staleness so
+        # whatever starved last round runs first this round (the r05
+        # blackout fix; without a budget the registry's cheap-first order
+        # is strictly better crash containment)
+        phases = _phase_order(phases)
     if os.environ.get("BENCH_PHASES"):      # subset, for debugging/tests
         want = set(os.environ["BENCH_PHASES"].split(","))
-        phases = [p for p in PHASES if p[1] in want]
+        phases = [p for p in phases if p[1] in want]
 
     # SIGTERM (a wrapping driver's kill) lands like Ctrl-C: emit the
     # partial record instead of dying with whatever was buffered

@@ -306,6 +306,41 @@ class InferenceEngine:
                          f"(multiple of 8, min 8, max 512)")
         return c if c < prompt_len else None
 
+    def prefill_plan(self, batch_size, prompt_len):
+        """Which prefill pipeline ``generate(batch, prompt)`` will take,
+        as ``(mode, chunk, reason)`` — ``("chunked", C, ...)`` for the
+        split per-chunk path, ``("one_pass", None, ...)`` otherwise.
+
+        Observability for long-prompt serving points (the bench records
+        it): the ``"auto"`` chunk policy declines chunking both for small
+        working sets AND when the Pallas chunk kernel is unavailable —
+        the latter silently drops a long prompt onto the one-pass path,
+        whose dense-attention fallback materializes ``[B, H, S, S]``
+        fp32 scores (~32 GB at bs16 x 4k) and OOMs where the chunked
+        pipeline runs fine.  Pin ``prefill_chunk_size`` to an int to
+        force the chunked pipeline regardless of the kernel gate (each
+        chunk then attends through ``cached_attention``'s paths, with a
+        dense per-chunk fallback of only ``[B, H, C, S_max]``)."""
+        cfg = self._config.prefill_chunk_size
+        chunk = self._prefill_chunk_for(int(batch_size), int(prompt_len))
+        if chunk is not None and chunk < prompt_len:
+            why = "explicit prefill_chunk_size" \
+                if cfg not in ("auto",) else "auto policy accepted"
+            return "chunked", chunk, why
+        if cfg in (None, 0, "none", "off"):
+            return "one_pass", None, "chunking disabled by config"
+        if cfg == "auto":
+            from deepspeed_tpu.ops.transformer.flash_attention import \
+                pallas_supported
+            if not pallas_supported():
+                return ("one_pass", None,
+                        "auto policy declined: Pallas chunk kernel "
+                        "unavailable on this backend")
+            return ("one_pass", None,
+                    "auto policy declined: working set under "
+                    "DSTPU_PREFILL_TOKEN_BUDGET")
+        return "one_pass", None, "chunk >= prompt_len"
+
     @hot_path("inference.generate")
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=-1, seed=None,
@@ -476,7 +511,10 @@ class InferenceEngine:
         new requests join freed KV slots between decode iterations instead
         of waiting for a whole ``generate()`` batch to finish.  Knobs come
         from the ``serving`` config block, overridable per call
-        (``engine.serve(num_slots=16)``)."""
+        (``engine.serve(num_slots=16)``); ``serving.paged=True`` swaps
+        the per-slot monolithic KV lanes for a block-table page pool
+        with copy-on-write prefix sharing (``engine.serve(paged=True,
+        page_size=64)``)."""
         from deepspeed_tpu.inference.serving.engine import ServingEngine
         return ServingEngine(self, monitor=monitor, **overrides)
 

@@ -11,6 +11,8 @@ never recompile anything).
 """
 
 from deepspeed_tpu.inference.serving.config import ServingConfig
+from deepspeed_tpu.inference.serving.paging import (PagePool,
+                                                    PrefixIndex)
 from deepspeed_tpu.inference.serving.slo import (CircuitBreaker,
                                                  CircuitOpen, DrainTimeout,
                                                  QueueFull, RequestResult,
@@ -18,7 +20,8 @@ from deepspeed_tpu.inference.serving.slo import (CircuitBreaker,
 
 __all__ = ["ServingConfig", "ServingEngine", "ServeRequest",
            "RequestStatus", "RequestResult", "QueueFull", "CircuitOpen",
-           "DrainTimeout", "CircuitBreaker", "serve_resilient"]
+           "DrainTimeout", "CircuitBreaker", "serve_resilient",
+           "PagePool", "PrefixIndex"]
 
 
 def __getattr__(name):

@@ -37,6 +37,30 @@ class ServingConfig(DeepSpeedConfigModel):
     # admission order: "fcfs" (arrival) | "shortest_first" (shortest
     # prompt first — lowers mean time-to-first-token under backlog)
     admission: str = "fcfs"
+    # ---- paged KV cache (docs/serving.md "Paged KV cache") ----
+    # paged=True replaces the per-slot monolithic lanes with a shared
+    # page pool + per-slot block tables (traced args — still ONE decode
+    # executable per server): HBM cost becomes num_pages * page_size
+    # instead of num_slots * max_cache_len, shared prefixes are stored
+    # once, and capacity pressure degrades into admission backpressure
+    # instead of an allocation cliff.  Default off = seed behavior.
+    paged: bool = False
+    # positions per page (rounded up to a multiple of 8 — sublane
+    # alignment — floor 8).  Smaller pages waste less per-request tail
+    # but cost a bigger table and finer gathers
+    page_size: int = 64
+    # physical pages in the pool, INCLUDING the reserved trash page 0;
+    # 0 = auto: num_slots * ceil(max_cache_len/page_size) + 1 (full
+    # worst-case capacity — no savings, no pressure).  Size it below
+    # auto to actual demand for the HBM win; admission then waits for
+    # free pages under pressure (queue backpressure, never corruption)
+    num_pages: int = 0
+    # copy-on-write prefix sharing (paged only): page-aligned leading
+    # blocks of a prompt that hash-match an earlier prompt map to the
+    # SAME physical pages, prefilled once; divergence re-prefills at
+    # most one page.  Unreferenced prefix pages evict LRU under pool
+    # pressure
+    prefix_cache: bool = True
     # sampling applied to every request (greedy when do_sample=False);
     # per-request eos_token_id/max_new_tokens ride the slot state instead
     do_sample: bool = False

@@ -38,6 +38,22 @@ PROCESS and deliberately bypass the persistent cache layers — reloaded
 serving executables corrupt the donated slot workspace (see the
 ``_persist_opt_out`` note in ``__init__``).
 
+**Paged KV cache** (``serving.paged``, ``docs/serving.md`` "Paged KV
+cache"): the per-slot monolithic lanes are replaced by one shared page
+pool ``[L, num_pages, page_size, KVH*D]`` plus per-slot page tables the
+host allocates and ships as TRACED arguments on every dispatch — HBM
+cost becomes ``num_pages × page_size`` instead of ``num_slots ×
+max_cache_len``, admission prefill writes straight into the slot's
+pages (no staging lane, no admit-time insert), hash-matched prompt
+prefixes map to the same refcounted physical pages (prefilled once,
+copy-on-write at page granularity via recompute-on-divergence), and
+pool pressure degrades into admission backpressure handled by the
+bounded queue instead of an allocation cliff.  The int8 KV path
+(``kv_cache_quant``) quantizes pool pages exactly like monolithic
+lanes, roughly doubling page capacity.  Still exactly ONE decode
+executable per server lifetime: page churn only changes table
+CONTENTS, never a program shape.
+
 **Robustness / SLO layer** (``docs/serving.md`` "Robustness & SLOs"):
 every request ends in a typed terminal status (``COMPLETED`` |
 ``SHED_DEADLINE`` | ``CANCELLED`` | ``ABORTED``); per-request wall-clock
@@ -66,6 +82,11 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.inference.serving.config import ServingConfig
+from deepspeed_tpu.inference.serving.paging import (PagePool,
+                                                    PagedPoolWorkspace,
+                                                    PrefixIndex,
+                                                    compact_page_str,
+                                                    pages_for)
 from deepspeed_tpu.inference.serving.slo import (CircuitBreaker,
                                                  DrainTimeout, QueueFull,
                                                  RequestResult,
@@ -73,7 +94,10 @@ from deepspeed_tpu.inference.serving.slo import (CircuitBreaker,
                                                  TERMINAL_STATUSES)
 from deepspeed_tpu.inference.serving.slots import (init_slot_state,
                                                    make_admit_fn,
-                                                   make_decode_block_fn)
+                                                   make_decode_block_fn,
+                                                   make_paged_admit_fn,
+                                                   make_paged_chunk_fn,
+                                                   make_paged_decode_block_fn)
 from deepspeed_tpu.runtime.fault import inject
 from deepspeed_tpu.utils.logging import log_dist, logger
 
@@ -123,6 +147,10 @@ class _PendingPrefill:
         self.fill_len = fill_len         # real positions incl. resume prefix
         self.ci = 0                      # chunks completed
         self.sel = None                  # last-real-position logits [1,1,V]
+        # paged admission: prefill starts at the shared-prefix boundary
+        # (page-aligned); positions < start are served by shared pages
+        self.start = 0
+        self.fill_tokens = None          # full fill (prefix registration)
 
 
 class _LanePool:
@@ -144,7 +172,8 @@ class _LanePool:
         return self._module.init_cache(1, cache_len, dtype=dtype)
 
     def give_back(self, lane):
-        self._lanes.append(lane)
+        if lane is not None:             # paged admissions have no lane
+            self._lanes.append(lane)
 
     def release(self):
         self._lanes.clear()
@@ -191,6 +220,28 @@ class ServingEngine:
             raise ValueError(f"serving.admission={cfg.admission!r}: "
                              f"one of 'fcfs', 'shortest_first'")
         self.block = max(1, int(cfg.decode_block))
+        # ---- paged KV cache (docs/serving.md "Paged KV cache") ----
+        self.paged = bool(cfg.paged)
+        if self.paged:
+            if not hasattr(type(self.module), "init_paged_cache"):
+                raise ValueError(
+                    f"serving.paged=True but "
+                    f"{type(self.module).__name__} has no "
+                    f"init_paged_cache — the paged pool needs model "
+                    f"support (models/transformer.py)")
+            # page size: multiple of 8 (sublane alignment), floor 8, and
+            # the virtual lane rounds UP to a whole number of pages
+            self.page = max(8, -(-int(cfg.page_size) // 8) * 8)
+            self.cache_len = -(-self.cache_len // self.page) * self.page
+            self.n_slot_pages = self.cache_len // self.page
+            # pool size incl. the reserved trash page 0; auto = full
+            # worst-case capacity (every slot at max_cache_len) — no HBM
+            # savings but also no pool pressure
+            self.num_pages = int(cfg.num_pages) \
+                or self.num_slots * self.n_slot_pages + 1
+            if self.num_pages < 2:
+                raise ValueError(f"serving.num_pages={cfg.num_pages}: "
+                                 f"need >= 2 (trash + 1 allocatable)")
 
         from deepspeed_tpu.inference.engine import (KVCacheWorkspace,
                                                     build_sample_fn)
@@ -199,16 +250,34 @@ class ServingEngine:
                                     int(cfg.top_k), float(cfg.top_p))
         sampling_key = (bool(cfg.do_sample), float(cfg.temperature),
                         int(cfg.top_k), float(cfg.top_p))
-        self._decode_fn = make_decode_block_fn(
-            self.module, sample_fn, engine._deq, self.block, self.cache_len)
-        self._admit_fn = make_admit_fn(sample_fn)
-        # stable program tags → the engine's AOT path persists/reloads
-        # these executables through the compile_cache store
-        engine._tags[id(self._decode_fn)] = (
-            "serving_decode", self.num_slots, self.cache_len, self.block,
-            sampling_key)
-        engine._tags[id(self._admit_fn)] = (
-            "serving_admit", self.num_slots, self.cache_len, sampling_key)
+        if self.paged:
+            # paged programs: the pool + per-slot page tables replace the
+            # monolithic slot lanes.  Page tables are traced arguments
+            # (rebuilt host-side per dispatch), so page churn/sharing
+            # never mints a new executable — still exactly ONE decode
+            # signature per server lifetime.
+            self._decode_fn = make_paged_decode_block_fn(
+                self.module, sample_fn, engine._deq, self.block,
+                self.cache_len)
+            self._admit_fn = make_paged_admit_fn(sample_fn)
+            engine._tags[id(self._decode_fn)] = (
+                "serving_decode_paged", self.num_slots, self.num_pages,
+                self.page, self.block, sampling_key)
+            engine._tags[id(self._admit_fn)] = (
+                "serving_admit_paged", self.num_slots, sampling_key)
+        else:
+            self._decode_fn = make_decode_block_fn(
+                self.module, sample_fn, engine._deq, self.block,
+                self.cache_len)
+            self._admit_fn = make_admit_fn(sample_fn)
+            # stable program tags → the engine's AOT path persists/reloads
+            # these executables through the compile_cache store
+            engine._tags[id(self._decode_fn)] = (
+                "serving_decode", self.num_slots, self.cache_len,
+                self.block, sampling_key)
+            engine._tags[id(self._admit_fn)] = (
+                "serving_admit", self.num_slots, self.cache_len,
+                sampling_key)
         # The serving programs must NOT be reloaded from either
         # persistent cache layer (serialized-executable store OR the XLA
         # disk cache): they chain one donated slot workspace across three
@@ -230,13 +299,31 @@ class ServingEngine:
         # lifetime invariant is untouched, and overload/drain/resume
         # cycles mint no further executables
         # (tests/unit/test_serving_slo.py).
-        self._chunk_fn = engine._make_chunk_fn()
-        engine._tags[id(self._chunk_fn)] = ("serving_prefill", self.chunk)
+        if self.paged:
+            # paged prefill writes straight into the slot's pool pages
+            # (no single-lane staging cache; the pool chains chunk ->
+            # decode by donation)
+            self._chunk_fn = make_paged_chunk_fn(self.module, engine._deq)
+            engine._tags[id(self._chunk_fn)] = (
+                "serving_prefill_paged", self.chunk, self.page)
+        else:
+            self._chunk_fn = engine._make_chunk_fn()
+            engine._tags[id(self._chunk_fn)] = ("serving_prefill",
+                                                self.chunk)
         for fn in (self._decode_fn, self._admit_fn, self._chunk_fn):
             engine._persist_opt_out.add(id(fn))
 
         self._cache_ws = KVCacheWorkspace(self.module)
         self._lane_pool = _LanePool(self.module)
+        if self.paged:
+            self._pool_ws = PagedPoolWorkspace(self.module)
+            self._pool = PagePool(self.num_pages)
+            self._prefix = PrefixIndex()
+            # host-owned page tables, shipped as a traced arg on every
+            # dispatch: [num_slots, pages_per_slot]; 0 = the trash page
+            self._page_table = np.zeros(
+                (self.num_slots, self.n_slot_pages), np.int32)
+            self._slot_pages = {}        # slot -> [physical page ids]
         self._cache = None
         self._state = None               # device-resident slot state
         # host mirror of slot occupancy, updated as events are PROCESSED
@@ -273,7 +360,9 @@ class ServingEngine:
                       "decode_tokens": 0, "prefill_tokens": 0,
                       "completed": 0, "admitted": 0, "wall_secs": 0.0,
                       "sync_secs": 0.0, "shed": 0, "cancelled": 0,
-                      "resumed": 0}
+                      "resumed": 0, "prefix_lookups": 0, "prefix_hits": 0,
+                      "prefix_tokens_reused": 0, "page_evictions": 0,
+                      "admission_stalls": 0}
         self.occupancy_trace = []                  # (iteration, n_active)
 
     # ------------------------------------------------------------------ #
@@ -318,6 +407,17 @@ class ServingEngine:
                 f"{max_new}, chunk-padded {padded}) but slot lanes hold "
                 f"{self.cache_len} — raise serving.max_cache_len or split "
                 f"the request")
+        if self.paged and pages_for(need, self.page) > self._pool.allocatable:
+            # a request the POOL can never satisfy must not enter the
+            # queue: with every other slot drained it would still stall
+            # admission forever (the per-request check above only bounds
+            # it against the virtual lane)
+            raise ValueError(
+                f"request needs {pages_for(need, self.page)} pages "
+                f"({need} positions at page_size={self.page}) but the "
+                f"pool holds {self._pool.allocatable} allocatable pages "
+                f"(num_pages={self.num_pages} incl. trash) — raise "
+                f"serving.num_pages or split the request")
         self._breaker.check_submit()         # reject-with-reason when open
         self._apply_backpressure()
         if deadline_s is None and self.config.default_deadline_s > 0:
@@ -373,6 +473,7 @@ class ServingEngine:
         if self._pending is not None and self._pending.req is req:
             self._lane_pool.give_back(self._pending.lane)
             self._free.append(int(self._pending.slot))
+            self._release_slot_pages(self._pending.slot)
             self._pending = None
             self._record_terminal(req, RequestStatus.CANCELLED,
                                   "cancelled during admission prefill")
@@ -391,6 +492,33 @@ class ServingEngine:
         the request is still queued/running."""
         return self._results.get(rid)
 
+    def _release_slot_pages(self, slot):
+        """Paged mode: return a retired slot's pages to the pool (shared
+        prefix pages just drop one reference) and point its table row at
+        the trash page — the NEXT dispatch's table redirects the zombie
+        lane's masked writes there, so a freed page can be reallocated
+        immediately (any write the zombie already has in flight executes
+        in device order BEFORE the new occupant's prefill and is either
+        overwritten or masked — docs/serving.md "Paged KV cache")."""
+        if not self.paged:
+            return
+        pages = self._slot_pages.pop(int(slot), None)
+        if pages is not None:
+            for pg in pages:
+                self._pool.decref(pg)
+        self._page_table[int(slot), :] = 0
+
+    def _paging_reset(self):
+        """Drop EVERY page mapping (pool bookkeeping, prefix index, all
+        table rows) — the pool buffer died with a failed dispatch or was
+        just (re)allocated, so no indexed content survives."""
+        if not self.paged:
+            return
+        self._prefix.clear(self._pool)
+        self._pool.reset()
+        self._page_table[:] = 0
+        self._slot_pages.clear()
+
     def _retire_slot_host_side(self, req):
         """Free a retired request's slot in the HOST MIRROR only — the
         device lane keeps masked-no-op decoding until the slot's next
@@ -404,6 +532,7 @@ class ServingEngine:
             self._mirror_active[s] = False
             self._slots[s] = None
             self._free.append(int(s))
+            self._release_slot_pages(s)
 
     def _record_terminal(self, req, status, detail):
         """Mark a non-COMPLETED terminal outcome and queue it for the
@@ -438,6 +567,7 @@ class ServingEngine:
                 and now >= p.req.deadline:
             self._lane_pool.give_back(p.lane)
             self._free.append(int(p.slot))
+            self._release_slot_pages(p.slot)
             self._pending = None
             self.stats["shed"] += 1
             self._record_terminal(p.req, RequestStatus.SHED_DEADLINE,
@@ -561,6 +691,12 @@ class ServingEngine:
                          f"({self._breaker.consecutive_failures} "
                          f"consecutive failures; last: "
                          f"{self._breaker.last_error})")
+        if self.paged:
+            lines.append(f"  page pool: {self._pool.in_use}"
+                         f"/{self._pool.allocatable} in use, "
+                         f"{len(self._prefix)} prefix entries, "
+                         f"{self.stats['admission_stalls']} admission "
+                         f"stall(s)")
         return "\n".join(lines)
 
     def close(self):
@@ -592,11 +728,16 @@ class ServingEngine:
         self._queue.clear()
         self._abort_in_flight("close()")
         if self._cache is not None:
-            self._cache_ws.give_back(self._cache)
+            if self.paged:
+                self._pool_ws.give_back(self._cache)
+            else:
+                self._cache_ws.give_back(self._cache)
             self._cache = None
         self._state = None
         self._cache_ws.release()
         self._lane_pool.release()
+        if self.paged:
+            self._pool_ws.release()
         self._closed = True
         self._close_report = undrained
         if undrained:
@@ -633,6 +774,7 @@ class ServingEngine:
         self._free = deque(range(self.num_slots))
         self._mirror_active[:] = False
         self._state = None
+        self._paging_reset()
         if lost:
             self.stats["aborted"] = self.stats.get("aborted", 0) + len(lost)
             logger.warning(f"serving {why}: aborted {len(lost)} in-flight "
@@ -651,6 +793,17 @@ class ServingEngine:
     def in_flight(self):
         """Dispatched device events not yet processed."""
         return len(self._events)
+
+    @property
+    def page_pool_utilization(self):
+        """Allocated fraction of the page pool (0.0 when not paged)."""
+        return self._pool.utilization() if self.paged else 0.0
+
+    @property
+    def prefix_hit_rate(self):
+        """Fraction of prefix-cache lookups that matched >= 1 page."""
+        n = self.stats["prefix_lookups"]
+        return self.stats["prefix_hits"] / n if n else 0.0
 
     # ------------------------------------------------------------------ #
     # Warmup — compile (or reload) the expensive programs up front
@@ -674,10 +827,15 @@ class ServingEngine:
         eng = self.engine
         N, S, C = self.num_slots, self.cache_len, self.chunk
         dtype = eng.compute_dtype
-        cache = jax.eval_shape(
-            lambda: self.module.init_cache(N, S, dtype=dtype))
-        lane = jax.eval_shape(
-            lambda: self.module.init_cache(1, S, dtype=dtype))
+        if self.paged:
+            cache = jax.eval_shape(
+                lambda: self.module.init_paged_cache(
+                    self.num_pages, self.page, dtype=dtype))
+        else:
+            cache = jax.eval_shape(
+                lambda: self.module.init_cache(N, S, dtype=dtype))
+            lane = jax.eval_shape(
+                lambda: self.module.init_cache(1, S, dtype=dtype))
         state = {
             "token": jax.ShapeDtypeStruct((N,), jnp.int32),
             "pos": jax.ShapeDtypeStruct((N,), jnp.int32),
@@ -701,14 +859,30 @@ class ServingEngine:
             eng._aot[sig] = compiled
             return {name: 0.0 if hit else dt}
 
-        cargs = (eng._params, lane,
-                 jax.ShapeDtypeStruct((1, C), jnp.int32),
-                 jax.ShapeDtypeStruct((), jnp.int32),
-                 jax.ShapeDtypeStruct((1,), jnp.int32))
-        report.update(warm(self._chunk_fn, cargs, f"serving_prefill:c{C}"))
-        report.update(warm(self._decode_fn,
-                           (eng._params, cache, state, rng),
-                           f"serving_decode:n{N}s{S}b{self.block}"))
+        if self.paged:
+            row = jax.ShapeDtypeStruct((1, self.n_slot_pages), jnp.int32)
+            tables = jax.ShapeDtypeStruct((N, self.n_slot_pages),
+                                          jnp.int32)
+            cargs = (eng._params, cache, row,
+                     jax.ShapeDtypeStruct((1, C), jnp.int32),
+                     jax.ShapeDtypeStruct((), jnp.int32),
+                     jax.ShapeDtypeStruct((1,), jnp.int32))
+            report.update(warm(self._chunk_fn, cargs,
+                               f"serving_prefill_paged:c{C}p{self.page}"))
+            report.update(warm(
+                self._decode_fn, (eng._params, cache, state, tables, rng),
+                f"serving_decode_paged:n{N}s{S}b{self.block}"
+                f"p{self.page}"))
+        else:
+            cargs = (eng._params, lane,
+                     jax.ShapeDtypeStruct((1, C), jnp.int32),
+                     jax.ShapeDtypeStruct((), jnp.int32),
+                     jax.ShapeDtypeStruct((1,), jnp.int32))
+            report.update(warm(self._chunk_fn, cargs,
+                               f"serving_prefill:c{C}"))
+            report.update(warm(self._decode_fn,
+                               (eng._params, cache, state, rng),
+                               f"serving_decode:n{N}s{S}b{self.block}"))
         for name, dt in report.items():
             log_dist(f"serving warmup[{name}]: "
                      + ("cached" if dt == 0.0 else f"{dt:.1f}s"), ranks=[0])
@@ -735,7 +909,17 @@ class ServingEngine:
             if self._pending is None:
                 if not self._queue or not self._free:
                     return
-                self._pending = self._start_prefill(self._pop_request())
+                req = self._pop_request()
+                pend = self._start_prefill(req)
+                if pend is None:
+                    # paged pool pressure: not enough free pages even
+                    # after evicting unreferenced prefix pages — the
+                    # request waits at the queue head until retirements
+                    # free pages (backpressure, never a partial grab)
+                    self._queue.appendleft(req)
+                    self.stats["admission_stalls"] += 1
+                    return
+                self._pending = pend
             done = self._run_prefill_chunk(self._pending)
             spent += self.chunk
             if done:
@@ -743,11 +927,13 @@ class ServingEngine:
                 self._dispatch_admit(pend)
 
     def _start_prefill(self, req):
+        fill = req.fill_ids              # prompt + any resumed tokens
+        P = len(fill)
+        if self.paged:
+            return self._start_prefill_paged(req, fill, P)
         slot = self._free.popleft()
         req.slot = slot
         req.status = RequestStatus.PREFILLING
-        fill = req.fill_ids              # prompt + any resumed tokens
-        P = len(fill)
         n = -(-P // self.chunk)
         ids_pad = np.zeros((1, n * self.chunk), np.int32)
         ids_pad[0, :P] = fill
@@ -755,18 +941,110 @@ class ServingEngine:
                                     self.engine.compute_dtype)
         return _PendingPrefill(req, slot, lane, ids_pad, n, P)
 
+    def _start_prefill_paged(self, req, fill, P):
+        """Paged admission: map the longest indexed prefix (full pages,
+        refcounted — prefilled ONCE per unique prefix), allocate private
+        pages for the rest of the virtual lane, and prefill only from
+        the shared boundary on.  Returns ``None`` (nothing popped,
+        nothing allocated) when the pool cannot back the request yet."""
+        dev_new = req.max_new - len(req.prefix)
+        matched = []
+        if self.config.prefix_cache:
+            # cap the match so the block holding the LAST prompt position
+            # is always recomputed: admission samples the first token
+            # from that position's logits, so at least one chunk must run
+            matched = self._prefix.lookup(fill, self.page, self._pool,
+                                          (P - 1) // self.page)
+        m = len(matched)
+        # the prefill start must be CHUNK-aligned, not just page-aligned:
+        # chunk ci writes the full padded span [s0+ci*C, s0+(ci+1)*C),
+        # and only a chunk-aligned s0 keeps the padded end at
+        # ceil(P/C)*C — the bound submit() already checked against the
+        # lane.  A page-aligned-only start can pad PAST the table row
+        # (page 16, chunk 64, P=120, m=7: 112+64=176 > 8-page lane)
+        g = self.chunk // math.gcd(self.page, self.chunk)
+        if m % g:
+            for pg in matched[(m // g) * g:]:
+                self._pool.decref(pg)
+            matched = matched[:(m // g) * g]
+            m = len(matched)
+        s0 = m * self.page               # prefill start
+        n_chunks = -(-(P - s0) // self.chunk)
+        # the slot's virtual extent: decode writes through P+dev_new-1,
+        # the padded last chunk writes through s0+n_chunks*C-1
+        virt = max(P + dev_new, s0 + n_chunks * self.chunk)
+        need_private = pages_for(virt, self.page) - m
+        got = self._pool.alloc(need_private)
+        if got is None and self.config.prefix_cache:
+            freed = self._prefix.evict(
+                self._pool, need_private - self._pool.free_count)
+            self.stats["page_evictions"] += freed
+            got = self._pool.alloc(need_private)
+        if got is None:
+            for pg in matched:
+                self._pool.decref(pg)
+            return None
+        if self.config.prefix_cache:
+            # stats count ADMISSIONS, not stalled retries of the same
+            # request (a 50-step stall must not record 50 lookups/hits)
+            self.stats["prefix_lookups"] += 1
+            if matched:
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_tokens_reused"] += m * self.page
+        slot = self._free.popleft()
+        req.slot = slot
+        req.status = RequestStatus.PREFILLING
+        row = matched + got
+        self._slot_pages[slot] = row
+        self._page_table[slot, :] = 0
+        self._page_table[slot, :len(row)] = row
+        ids_pad = np.zeros((1, n_chunks * self.chunk), np.int32)
+        ids_pad[0, :P - s0] = fill[s0:]
+        pend = _PendingPrefill(req, slot, None, ids_pad, n_chunks, P)
+        pend.start = s0
+        pend.fill_tokens = fill
+        return pend
+
     def _run_prefill_chunk(self, p):
         C = self.chunk
         P = p.fill_len
-        local = int(min(max(P - 1 - p.ci * C, 0), C - 1))
+        # chunk ci covers absolute positions [start + ci*C, start +
+        # (ci+1)*C); start > 0 only for paged shared-prefix admissions
+        local = int(min(max(P - 1 - p.start - p.ci * C, 0), C - 1))
         try:
-            logits, p.lane = self.engine._run_guarded(
-                self._chunk_fn,
-                (self.engine._params, p.lane,
-                 jnp.asarray(p.ids_pad[:, p.ci * C:(p.ci + 1) * C]),
-                 jnp.asarray(p.ci * C, jnp.int32),
-                 jnp.asarray([local], jnp.int32)))
+            if self.paged:
+                # the chunk writes straight into the slot's pool pages —
+                # the POOL is the donated buffer, chained with decode
+                row = jnp.asarray(
+                    self._page_table[p.slot:p.slot + 1])
+                logits, self._cache = self.engine._run_guarded(
+                    self._chunk_fn,
+                    (self.engine._params, self._cache, row,
+                     jnp.asarray(p.ids_pad[:, p.ci * C:(p.ci + 1) * C]),
+                     jnp.asarray(p.start + p.ci * C, jnp.int32),
+                     jnp.asarray([local], jnp.int32)))
+            else:
+                logits, p.lane = self.engine._run_guarded(
+                    self._chunk_fn,
+                    (self.engine._params, p.lane,
+                     jnp.asarray(p.ids_pad[:, p.ci * C:(p.ci + 1) * C]),
+                     jnp.asarray(p.ci * C, jnp.int32),
+                     jnp.asarray([local], jnp.int32)))
         except BaseException as e:
+            if self.paged:
+                # the donated POOL may be dead — this is a decode-grade
+                # failure: every in-flight request's KV lived in it
+                self._pool_ws.give_back(self._cache)
+                self._cache = None
+                if p.req.status not in TERMINAL_STATUSES:
+                    self._record_terminal(
+                        p.req, RequestStatus.ABORTED,
+                        f"admission prefill dispatch failed: "
+                        f"{type(e).__name__}: {e}")
+                self._abort_in_flight(
+                    f"paged prefill dispatch failed "
+                    f"(request {p.req.rid} lost)")
+                raise
             # the donated lane may be dead — drop only THIS admission
             # (the decode workspace is untouched by a prefill failure)
             self._lane_pool.give_back(p.lane)
@@ -782,7 +1060,7 @@ class ServingEngine:
                            f"{p.req.rid} dropped")
             raise
         self._breaker.record_success()
-        if (P - 1) // C == p.ci:
+        if (P - 1 - p.start) // C == p.ci:
             # this chunk held the prompt's last real position — its
             # selected logits seed the first sampled token (device-side;
             # never synchronized here)
@@ -802,18 +1080,35 @@ class ServingEngine:
         self._rng, sub = jax.random.split(self._rng)
         try:
             inject.fire("serving.pre_admit")
-            self._cache, self._state, first = self.engine._run_guarded(
-                self._admit_fn,
-                (self._cache, self._state, p.lane, p.sel, sub,
-                 jnp.asarray(p.slot, jnp.int32),
-                 jnp.asarray(p.fill_len, jnp.int32),
-                 jnp.asarray(dev_new, jnp.int32),
-                 jnp.asarray(req.eos, jnp.int32)))
+            if self.paged:
+                # the prompt's K/V already sits in the slot's pages —
+                # paged admission is just the first-token sample + the
+                # in-program slot-state write (state donated)
+                self._state, first = self.engine._run_guarded(
+                    self._admit_fn,
+                    (self._state, p.sel, sub,
+                     jnp.asarray(p.slot, jnp.int32),
+                     jnp.asarray(p.fill_len, jnp.int32),
+                     jnp.asarray(dev_new, jnp.int32),
+                     jnp.asarray(req.eos, jnp.int32)))
+            else:
+                self._cache, self._state, first = \
+                    self.engine._run_guarded(
+                        self._admit_fn,
+                        (self._cache, self._state, p.lane, p.sel, sub,
+                         jnp.asarray(p.slot, jnp.int32),
+                         jnp.asarray(p.fill_len, jnp.int32),
+                         jnp.asarray(dev_new, jnp.int32),
+                         jnp.asarray(req.eos, jnp.int32)))
         except BaseException as e:
             # cache/state were donated — same recovery as a decode
-            # failure (this admission's request is lost with them)
-            self._cache_ws.give_back(self._cache)
-            self._cache = None
+            # failure (this admission's request is lost with them).
+            # Paged: only the STATE died (the pool is not an admit
+            # argument); _abort_in_flight still resets all paging
+            # bookkeeping, so stale KV is never attended.
+            if not self.paged:
+                self._cache_ws.give_back(self._cache)
+                self._cache = None
             self._lane_pool.give_back(p.lane)
             if req.status not in TERMINAL_STATUSES:
                 self._record_terminal(req, RequestStatus.ABORTED,
@@ -823,6 +1118,15 @@ class ServingEngine:
                                   f"(request {req.rid} lost)")
             raise
         self._breaker.record_success()
+        if self.paged and self.config.prefix_cache \
+                and p.fill_tokens is not None:
+            # index this request's full-prompt pages as sharable —
+            # their prefill writes are complete (dispatched before this
+            # admit) and nothing ever writes them again (the slot's own
+            # writes land at positions >= fill_len)
+            self._prefix.register(p.fill_tokens, self.page,
+                                  self._slot_pages[p.slot], self._pool,
+                                  p.fill_len // self.page)
         self._slot_last_dispatch[int(p.slot)] = time.monotonic()
         req.status = RequestStatus.RUNNING
         self._slots[p.slot] = req
@@ -841,16 +1145,25 @@ class ServingEngine:
         self._rng, sub = jax.random.split(self._rng)
         try:
             inject.fire("serving.pre_decode_dispatch")
-            toks, self._cache, self._state = self.engine._run_guarded(
-                self._decode_fn,
-                (self.engine._params, self._cache, self._state, sub))
+            if self.paged:
+                toks, self._cache, self._state = self.engine._run_guarded(
+                    self._decode_fn,
+                    (self.engine._params, self._cache, self._state,
+                     jnp.asarray(self._page_table), sub))
+            else:
+                toks, self._cache, self._state = self.engine._run_guarded(
+                    self._decode_fn,
+                    (self.engine._params, self._cache, self._state, sub))
         except BaseException:
             # the donated cache/state may be dead — drop them so the next
             # step's workspace take() reallocates, and abort everything
             # past admission (its KV rows died with the buffers; stale
             # events/slot bookkeeping must not survive into the fresh
             # state).  Queued requests are untouched.
-            self._cache_ws.give_back(self._cache)
+            if self.paged:
+                self._pool_ws.give_back(self._cache)
+            else:
+                self._cache_ws.give_back(self._cache)
             self._cache = None
             self._abort_in_flight("decode dispatch failed")
             raise
@@ -887,6 +1200,7 @@ class ServingEngine:
             # next occupant's admit overwrites it
             self._slots[slot] = None
             self._free.append(int(slot))
+            self._release_slot_pages(slot)
             return
         if req.first_tok_t is None:
             req.first_tok_t = time.monotonic()
@@ -897,6 +1211,7 @@ class ServingEngine:
         if (req.eos >= 0 and first == req.eos) or dev_new == 1:
             self._slots[slot] = None
             self._free.append(int(slot))
+            self._release_slot_pages(slot)
             finished[req.rid] = self._finalize(req)
         else:
             self._mirror_active[slot] = True
@@ -919,6 +1234,7 @@ class ServingEngine:
                     self._mirror_active[s] = False
                     self._slots[s] = None
                     self._free.append(int(s))
+                    self._release_slot_pages(s)
                     finished[req.rid] = self._finalize(req)
         self.occupancy_trace.append(
             (self._it, int(self._mirror_active.sum())))
@@ -1020,11 +1336,17 @@ class ServingEngine:
         self._free = deque(range(self.num_slots))
         self._mirror_active[:] = False
         if self._cache is not None:
-            self._cache_ws.give_back(self._cache)
+            if self.paged:
+                self._pool_ws.give_back(self._cache)
+            else:
+                self._cache_ws.give_back(self._cache)
             self._cache = None
         self._state = None
         self._cache_ws.release()
         self._lane_pool.release()
+        self._paging_reset()
+        if self.paged:
+            self._pool_ws.release()
         self._closed = True
         self._close_report = sorted(snapped)
         self.stats["drain_secs"] = \
@@ -1063,7 +1385,7 @@ class ServingEngine:
                     f"{type(cid).__name__} is not JSON-serializable — "
                     f"stored as str()")
                 cid = str(cid)
-            reqs.append({
+            entry = {
                 "rid": int(r.rid),
                 "client_id": cid,
                 "prompt": [int(t) for t in r.ids],
@@ -1075,7 +1397,15 @@ class ServingEngine:
                 "deadline_remaining_s":
                     None if r.deadline is None else r.deadline - now,
                 "submitted_it": int(r.submitted_it),
-            })
+            }
+            if self.paged and r.slot is not None \
+                    and int(r.slot) in self._slot_pages:
+                # diagnostics only (restore re-prefills; physical pages
+                # are meaningless in another process) — range-compressed,
+                # never one JSON int per table entry
+                entry["pages"] = compact_page_str(
+                    self._slot_pages[int(r.slot)])
+            reqs.append(entry)
         fcfg = getattr(self.engine._config, "fault", None)
         state = {
             "seq": int(self._snap_seq),
@@ -1135,8 +1465,8 @@ class ServingEngine:
             # — admitting an oversized request would stream prefill
             # chunks past the lane's end)
             P = len(ids)
-            if max(P + max_new,
-                   -(-P // self.chunk) * self.chunk) > self.cache_len:
+            need = max(P + max_new, -(-P // self.chunk) * self.chunk)
+            if need > self.cache_len:
                 self._requests[req.rid] = req
                 self._record_terminal(
                     req, RequestStatus.ABORTED,
@@ -1146,6 +1476,24 @@ class ServingEngine:
                     f"serving.max_cache_len to resume it")
                 logger.warning(f"serving restore: request {req.rid} does "
                                f"not fit this server's lanes — ABORTED")
+                self._next_rid = max(self._next_rid, req.rid + 1)
+                continue
+            if self.paged and pages_for(need, self.page) \
+                    > self._pool.allocatable:
+                # the snapshot may come from a server with a bigger page
+                # pool — mirror submit()'s pool-capacity check instead
+                # of stalling admission forever on an unfittable request
+                self._requests[req.rid] = req
+                self._record_terminal(
+                    req, RequestStatus.ABORTED,
+                    f"restored request needs "
+                    f"{pages_for(need, self.page)} pages but this "
+                    f"server's pool holds {self._pool.allocatable} "
+                    f"allocatable (num_pages={self.num_pages} incl. "
+                    f"trash) — raise serving.num_pages to resume it")
+                logger.warning(f"serving restore: request {req.rid} does "
+                               f"not fit this server's page pool — "
+                               f"ABORTED")
                 self._next_rid = max(self._next_rid, req.rid + 1)
                 continue
             # the resumed fill (prompt + prefix) must still fit a lane;
@@ -1178,8 +1526,16 @@ class ServingEngine:
     # ------------------------------------------------------------------ #
     def _ensure_workspace(self):
         if self._cache is None:
-            self._cache = self._cache_ws.take(
-                self.num_slots, self.cache_len, self.engine.compute_dtype)
+            if self.paged:
+                self._cache = self._pool_ws.take(
+                    self.num_pages, self.page, self.engine.compute_dtype)
+                # fresh (or reallocated) pool buffer: the host mirror
+                # must match it — everything free, nothing indexed
+                self._paging_reset()
+            else:
+                self._cache = self._cache_ws.take(
+                    self.num_slots, self.cache_len,
+                    self.engine.compute_dtype)
         if self._state is None:
             self._state = {k: jnp.asarray(v) for k, v in
                            init_slot_state(self.num_slots).items()}
@@ -1206,4 +1562,8 @@ class ServingEngine:
             ("Serving/aborted", self.stats.get("aborted", 0), self._it),
             ("Serving/breaker_open",
              1.0 if self._breaker.open else 0.0, self._it),
-        ])
+        ] + ([
+            ("Serving/page_pool_util", self.page_pool_utilization,
+             self._it),
+            ("Serving/prefix_hit_rate", self.prefix_hit_rate, self._it),
+        ] if self.paged else []))

@@ -144,3 +144,111 @@ def make_admit_fn(sample_fn):
         return cache, state, first
 
     return jax.jit(admit, donate_argnums=(0, 1))
+
+
+# --------------------------------------------------------------------- #
+# Paged variants (docs/serving.md "Paged KV cache"): the KV workspace is
+# a page POOL [L, num_pages, page_size, KVH*D] shared by all slots, and
+# the per-slot page tables ([num_slots, pages_per_slot] int32) arrive as
+# a TRACED argument on every dispatch — the host allocates/frees/shares
+# pages, the programs' shapes never change.  Prefill writes land in the
+# pool directly (make_paged_chunk_fn), so the paged admit has no lane to
+# insert: it only samples the first token and flips the slot state.
+# --------------------------------------------------------------------- #
+
+def make_paged_decode_block_fn(module, sample_fn, param_transform, block,
+                               cache_len):
+    """The paged decode step:
+    ``fn(params, cache, state, pages, rng) -> (tokens, cache, state)``
+    with the page POOL and the slot state donated (argnums 1, 2) and the
+    page table a plain traced input (tiny; rebuilt host-side per
+    dispatch).  ``cache_len`` is the VIRTUAL lane length
+    (pages_per_slot * page_size) — the dead-lane position clamp bound.
+    Per-step math is identical to :func:`make_decode_block_fn`; only the
+    cache write/read routes through the page table (see
+    ``models/transformer.py`` ``_paged_write``/``_paged_gather``), so
+    greedy paged serving stays bitwise equal to solo ``generate()``."""
+    deq = param_transform if param_transform is not None else (lambda p: p)
+
+    @hot_path("serving.decode_step_paged")
+    def decode_block(params, cache, state, pages, rng):
+        eos = state["eos"]
+
+        def step(carry, _):
+            cache, tok, pos, active, remaining, rng = carry
+            # inactive lanes decode as masked no-ops but still WRITE a
+            # k/v row each step — point their whole table row at the
+            # trash page so the write can never land in pages the host
+            # already handed to a newer occupant.  (The monolithic path
+            # tolerates those writes because the next admit re-inserts
+            # the whole lane; paged prefill writes the pool directly
+            # BEFORE the admit flips `active`, so an unmasked free-lane
+            # write here would corrupt a freshly prefilled prompt.)
+            safe_pages = jnp.where(active[:, None], pages, 0)
+            logits, cache = module.apply(
+                deq(params), tok[:, None],
+                {**cache, "pages": safe_pages},
+                pos, method=type(module).decode)
+            rng, sub = jax.random.split(rng)
+            nxt = sample_fn(logits[:, -1], sub).astype(jnp.int32)
+            nxt = jnp.where(active, nxt, eos)
+            done_now = active & ((nxt == eos) | (remaining <= 1))
+            active = active & jnp.logical_not(done_now)
+            # dead lanes clamp to the last virtual position — its table
+            # entry is the trash page once the host processed retirement
+            pos = jnp.minimum(pos + 1, cache_len - 1)
+            remaining = jnp.maximum(remaining - 1, 0)
+            return (cache, nxt, pos, active, remaining, rng), nxt
+
+        (cache, tok, pos, active, remaining, _), toks = jax.lax.scan(
+            step, (cache, state["token"], state["pos"], state["active"],
+                   state["remaining"], rng), None, length=block)
+        new_state = {"token": tok, "pos": pos, "active": active,
+                     "remaining": remaining, "eos": eos}
+        return toks, cache, new_state
+
+    return jax.jit(decode_block, donate_argnums=(1, 2))
+
+
+def make_paged_chunk_fn(module, param_transform):
+    """The paged admission-prefill chunk program:
+    ``fn(params, cache, pages, chunk_ids, start, logits_at)`` — same
+    body as the engine's per-chunk program but writing straight into the
+    slot's pool pages through its ``[1, pages_per_slot]`` table row (no
+    single-lane staging cache, no admit-time insert).  The POOL is
+    donated (argnum 1); the table row is a separate traced input so the
+    donation aliases cleanly."""
+    deq = param_transform if param_transform is not None else (lambda p: p)
+
+    @hot_path("serving.prefill_chunk_paged")
+    def chunk_step(params, cache, pages, chunk_ids, start, logits_at):
+        return module.apply(deq(params), chunk_ids,
+                            {**cache, "pages": pages}, start,
+                            method=type(module).decode,
+                            logits_at=logits_at)
+
+    return jax.jit(chunk_step, donate_argnums=(1,))
+
+
+def make_paged_admit_fn(sample_fn):
+    """The paged admission program:
+    ``fn(state, logits, rng, slot, pos0, max_new, eos) -> (state,
+    first_token)`` with the slot state donated (argnum 0).  The prefill
+    already wrote the prompt's K/V into the slot's pages, so admission
+    is just the first-token sample (same ``build_sample_fn`` rule — the
+    bitwise contract) plus the in-program slot-state write."""
+
+    @hot_path("serving.admit_paged")
+    def admit(state, logits, rng, slot, pos0, max_new, eos):
+        first = sample_fn(logits[:, 0], rng).astype(jnp.int32)[0]
+        active0 = (max_new > 1) & jnp.logical_not(first == eos)
+        upd = lambda arr, val: arr.at[slot].set(val)
+        state = {"token": upd(state["token"], first),
+                 "pos": upd(state["pos"], pos0),
+                 "active": upd(state["active"], active0),
+                 "remaining": upd(state["remaining"],
+                                  jnp.maximum(max_new - 1, 0)),
+                 "eos": upd(state["eos"], eos)}
+        return state, first
+
+    return jax.jit(admit, donate_argnums=(0,))

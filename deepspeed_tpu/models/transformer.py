@@ -392,6 +392,73 @@ def _cache_data(cache):
     return {kk: cache[kk] for kk in _CACHE_DATA_KEYS if kk in cache}
 
 
+def _paged_write(cache, k_new, v_new, ks_new, vs_new, positions, per_row):
+    """Scatter this step's K/V rows into a PAGED cache pool.
+
+    Pool layout (``init_paged_cache``): ``[L, num_pages, page_size,
+    KVH*D]``; ``cache["pages"]`` is the per-row page table ``[B,
+    n_pages]`` mapping virtual page index ``pos // page_size`` to a
+    physical page.  Each virtual write position resolves to ``(pages[b,
+    pos // page], pos % page)`` — one batched scatter per buffer, no
+    per-page Python loop, so the program shape is independent of where
+    the host placed the pages.  Unmapped virtual pages alias the
+    reserved TRASH page 0: retired/free lanes keep scattering masked
+    garbage there instead of into reclaimed pages (the paged analog of
+    the dense path's "dead lanes write into their own lane" safety
+    argument)."""
+    li = cache["layer"]
+    pages = cache["pages"]                      # [B, n_pages] int32
+    page = cache["k"].shape[-2]
+    B_, S_ = k_new.shape[0], k_new.shape[1]
+    if per_row:
+        pos = positions[:, 0]                   # [B] per-row decode
+        pidx = (pos // page).astype(jnp.int32)
+        off = (pos % page).astype(jnp.int32)
+        phys = pages[jnp.arange(B_), pidx]      # [B]
+
+        def w(buf, new):
+            return buf.at[li, phys, off].set(new[:, 0].astype(buf.dtype))
+    else:
+        # row-uniform multi-token block (chunked prefill / shared-pos
+        # decode): positions start..start+S-1 may span page boundaries
+        pos = positions[0, 0] + jnp.arange(S_)  # [S]
+        pidx = (pos // page).astype(jnp.int32)
+        off = jnp.broadcast_to((pos % page).astype(jnp.int32), (B_, S_))
+        phys = pages[:, pidx]                   # [B, S]
+
+        def w(buf, new):
+            return buf.at[li, phys, off].set(new.astype(buf.dtype))
+
+    out = {"k": w(cache["k"], k_new), "v": w(cache["v"], v_new)}
+    if ks_new is not None:
+        out["k_scale"] = w(cache["k_scale"], ks_new)
+        out["v_scale"] = w(cache["v_scale"], vs_new)
+    return out
+
+
+def _paged_gather(cache):
+    """Materialize THIS layer's virtual [B, n_pages*page_size, ...] view
+    of the paged pool via the page table — a transient 1/L the size of
+    the monolithic per-layer cache slice the dense paths already
+    materialize.  Virtual positions on unmapped (trash) pages carry
+    garbage; every attention path masks KV positions beyond each query's
+    own position, and the host never maps a live write/read position to
+    the trash page, so the garbage is never attended."""
+    li, pages = cache["layer"], cache["pages"]
+    B, n = pages.shape
+    page = cache["k"].shape[-2]
+
+    def g(buf):
+        v = buf[li, pages]                      # [B, n, page, F]
+        return v.reshape(B, n * page, v.shape[-1])
+
+    out = {"k": g(cache["k"]), "v": g(cache["v"])}
+    if "k_scale" in cache:
+        out["k_scale"] = g(cache["k_scale"])
+        out["v_scale"] = g(cache["v_scale"])
+    return out
+
+
 def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
                      window=None, layer=None, k_scale=None, v_scale=None,
                      int8_matmuls=False):
@@ -524,7 +591,10 @@ def _fused_decode_step(cfg, q, k, v, positions, cache, bias, window, S_):
     in-place write the reference gets from its workspace pointer
     arithmetic (``inference_context.h:24-87``) has to live INSIDE the
     kernel here."""
-    if S_ != 1 or bias is not None or cfg.decode_int8_matmuls:
+    if S_ != 1 or bias is not None or cfg.decode_int8_matmuls \
+            or "pages" in cache:
+        # paged caches scatter through the page table instead — the fused
+        # kernel's aliased write stripe assumes the monolithic layout
         return None
     if cache["k"].shape[-2] % 8 != 0:
         # the write-stripe outputs are 8-sublane-aligned blocks; odd cache
@@ -589,8 +659,13 @@ class Attention(nn.Module):
             # flash/decode kernels need no changes
             q = q * jnp.asarray(cfg.attention_softmax_scale * np.sqrt(D),
                                 q.dtype)
-        bias = alibi_bias(H, cache["k"].shape[-2] if cache is not None
-                          else x.shape[1]) \
+        if cache is None:
+            kv_len = x.shape[1]
+        elif "pages" in cache:               # paged: the virtual length
+            kv_len = cache["pages"].shape[1] * cache["k"].shape[-2]
+        else:
+            kv_len = cache["k"].shape[-2]
+        bias = alibi_bias(H, kv_len) \
             if cfg.position_embedding == "alibi" else None
         if cache is not None:
             if cfg.sparse_attention is not None:
@@ -669,7 +744,30 @@ class Attention(nn.Module):
                             buf, new.astype(buf.dtype), (0, start, 0))
                     return jax.lax.dynamic_update_slice(
                         buf, new[None].astype(buf.dtype), (li, 0, start, 0))
-            if "layer" in cache:
+            if "pages" in cache:
+                # PAGED cache (serving block tables, docs/serving.md):
+                # the pool is [L, num_pages, page, KVH*D] and the page
+                # table rides the cache dict as a traced argument.  Write
+                # through the table (one batched scatter), attend over
+                # the gathered per-layer virtual view — page allocation,
+                # sharing and reuse are entirely the host scheduler's
+                # business, so admissions/retirements/prefix hits never
+                # change this program's shape.
+                data = _paged_write(
+                    cache, k_new, v_new, ks_new, vs_new, positions,
+                    per_row=(S_ == 1 and "per_row" in cache))
+                new_cache = {**data, "layer": cache["layer"],
+                             "pages": cache["pages"],
+                             **({"per_row": cache["per_row"]}
+                                if "per_row" in cache else {})}
+                if not prefill_from_zero:
+                    g = _paged_gather(new_cache)
+                    out = cached_attention(
+                        q, g["k"], g["v"], positions, bias=bias,
+                        window=window, k_scale=g.get("k_scale"),
+                        v_scale=g.get("v_scale"),
+                        int8_matmuls=cfg.decode_int8_matmuls)
+            elif "layer" in cache:
                 # stacked-carry decode: the FULL [L, B, S_max, KVH*D]
                 # cache rides the layer-scan carry and only this step's
                 # tokens are written — never a full-cache rewrite per
@@ -926,6 +1024,10 @@ class Transformer(nn.Module):
         if cfg.embedding_norm:
             x = self.embed_norm(x).astype(cfg.jnp_dtype)
         marker = {"per_row": jnp.zeros((), jnp.int32)} if per_row_pos else {}
+        if cache is not None and "pages" in cache:
+            # paged pool: the per-row page table threads every layer's
+            # cache dict unchanged (pages are constant across layers)
+            marker["pages"] = cache["pages"]
         # from-zero multi-token prefill, decided where the start is
         # still STATICALLY visible (generation passes a literal 0;
         # inside the remat-wrapped block `positions` is a tracer):
@@ -1085,6 +1187,27 @@ class Transformer(nn.Module):
         cfg = self.config
         dtype = dtype or cfg.jnp_dtype
         shape = (cfg.num_layers, batch_size, max_len,
+                 cfg.kv_heads * cfg.head_dim)
+        if cfg.kv_cache_quant:
+            sshape = shape[:-1] + (cfg.kv_heads,)
+            return {"k": jnp.zeros(shape, jnp.int8),
+                    "v": jnp.zeros(shape, jnp.int8),
+                    "k_scale": jnp.zeros(sshape, jnp.float32),
+                    "v_scale": jnp.zeros(sshape, jnp.float32)}
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def init_paged_cache(self, num_pages, page_size, dtype=None):
+        """Zero PAGED KV pool: ``[L, num_pages, page_size, KVH*D]`` per
+        k/v (+ per-(position, kv-head) scales with ``kv_cache_quant``).
+        Physical pages are position-order-free: a consumer threads a
+        per-row page table (``cache["pages"]``: virtual page ``pos //
+        page_size`` → physical page) through ``decode``, and the
+        attention paths see the gathered virtual view.  Page 0 is
+        conventionally the serving engine's reserved trash page (never
+        allocated; unmapped table entries point at it)."""
+        cfg = self.config
+        dtype = dtype or cfg.jnp_dtype
+        shape = (cfg.num_layers, int(num_pages), int(page_size),
                  cfg.kv_heads * cfg.head_dim)
         if cfg.kv_cache_quant:
             sshape = shape[:-1] + (cfg.kv_heads,)

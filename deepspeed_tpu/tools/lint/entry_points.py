@@ -207,9 +207,77 @@ def serving_admit():
     return EntryPoint("serving.admit", fn, args, expect_donation=True)
 
 
+def _paged_state(N):
+    return {"token": jnp.zeros((N,), jnp.int32),
+            "pos": jnp.asarray([8, 3], jnp.int32),
+            "active": jnp.asarray([True, False]),
+            "remaining": jnp.asarray([4, 0], jnp.int32),
+            "eos": jnp.full((N,), -1, jnp.int32)}
+
+
+def serving_decode_step_paged():
+    """The PAGED decode-step program (``serving.paged``): page pool +
+    slot state donated, the per-slot page tables a plain traced input —
+    the pool/state donations must alias (the whole paged design rests on
+    in-place pool updates) and the program must stay callback-free even
+    though every cache touch routes through a gather/scatter."""
+    from deepspeed_tpu.inference.engine import build_sample_fn
+    from deepspeed_tpu.inference.serving.slots import \
+        make_paged_decode_block_fn
+    engine = _tiny_inference_engine()
+    N, NP, PG = 2, 9, 8                 # 9 pages of 8 (page 0 = trash)
+    fn = make_paged_decode_block_fn(engine.module,
+                                    build_sample_fn(False, 1.0, 0, 1.0),
+                                    None, 2, 4 * PG)
+    pool = engine.module.init_paged_cache(NP, PG,
+                                          dtype=engine.compute_dtype)
+    pages = jnp.asarray([[3, 5, 2, 7], [1, 4, 0, 0]], jnp.int32)
+    args = (engine._params, pool, _paged_state(N), pages,
+            jax.random.key(0))
+    return EntryPoint("serving.decode_step_paged", fn, args,
+                      expect_donation=True)
+
+
+def serving_admission_prefill_paged():
+    """The PAGED admission-prefill chunk program: the pool is the
+    donated buffer (chunk writes land in the slot's pages directly —
+    no staging lane), the [1, pages_per_slot] table row a separate
+    traced input so the pool donation aliases cleanly."""
+    from deepspeed_tpu.inference.serving.slots import make_paged_chunk_fn
+    engine = _tiny_inference_engine()
+    C, NP, PG = 8, 9, 8
+    chunk_fn = make_paged_chunk_fn(engine.module, None)
+    pool = engine.module.init_paged_cache(NP, PG,
+                                          dtype=engine.compute_dtype)
+    pages = jnp.asarray([[3, 5, 2, 7]], jnp.int32)
+    ids = jnp.asarray(np.random.default_rng(4).integers(0, 97, (1, C)),
+                      jnp.int32)
+    args = (engine._params, pool, pages, ids, jnp.asarray(0, jnp.int32),
+            jnp.zeros((1,), jnp.int32))
+    return EntryPoint("serving.prefill_chunk_paged", chunk_fn, args,
+                      expect_donation=True)
+
+
+def serving_admit_paged():
+    """The PAGED admission program (first-token sample + in-program
+    slot-state write; no cache argument at all — prefill already wrote
+    the pages)."""
+    from deepspeed_tpu.inference.engine import build_sample_fn
+    from deepspeed_tpu.inference.serving.slots import make_paged_admit_fn
+    fn = make_paged_admit_fn(build_sample_fn(False, 1.0, 0, 1.0))
+    logits = jnp.zeros((1, 1, 97), jnp.float32)
+    args = (_paged_state(2), logits, jax.random.key(0),
+            jnp.asarray(1, jnp.int32), jnp.asarray(8, jnp.int32),
+            jnp.asarray(4, jnp.int32), jnp.asarray(-1, jnp.int32))
+    return EntryPoint("serving.admit_paged", fn, args,
+                      expect_donation=True)
+
+
 BUILDERS = (runtime_train_step, runtime_apply_update, inference_decode,
             inference_prefill_chunk, serving_decode_step,
-            serving_admission_prefill, serving_admit)
+            serving_admission_prefill, serving_admit,
+            serving_decode_step_paged, serving_admission_prefill_paged,
+            serving_admit_paged)
 
 
 def iter_entry_points():

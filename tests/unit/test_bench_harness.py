@@ -138,6 +138,55 @@ def test_bench_suite_budget_skips_and_records(tmp_path):
     assert "skipped" in rec["calibration"]
 
 
+def test_bench_round_robin_phase_order(tmp_path, monkeypatch):
+    """Under BENCH_SUITE_BUDGET phase order rotates by staleness across
+    rounds (the r05 blackout: a fixed cheap-first order measured the same
+    3 leading phases every round): phases starved in earlier rounds run
+    before phases measured last round, calibration stays pinned first,
+    and a fresh machine (no BENCH_r* trail) keeps the registry's
+    cheap-first order.  Pure host logic — no jax, no subprocess."""
+    monkeypatch.setenv("BENCH_OUT_DIR", str(tmp_path))
+    monkeypatch.syspath_prepend(REPO)
+    import bench
+    base = [k for k, _, _ in bench.PHASES]
+    assert "serving_paged" in base          # the paged phase is registered
+    # no trail: registry (cheap-first) order is preserved verbatim
+    assert [k for k, _, _ in bench._phase_order(bench.PHASES)] == base
+
+    # round 1's budget afforded calibration + guard + north; offload was
+    # skipped, decode timed out, the rest never ran
+    r1 = {"metric": "m", "unit": "tokens/s/chip",
+          "calibration": {"measured_hbm_gbps": 1.0},
+          "sft_350m_guard": {"mfu": 0.3},
+          "north_star": {"mfu": 0.4},
+          "optimizer_offload": {"skipped": "suite budget exhausted"},
+          "generation": {"error": "timeout after 900s", "timeout": True}}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(r1))
+    # a corrupt trail file must be skipped, never wedge scheduling
+    (tmp_path / "BENCH_r02.json").write_text("{half a reco")
+    order = [k for k, _, _ in bench._phase_order(bench.PHASES)]
+    assert order[0] == "calibration"
+    assert sorted(order) == sorted(base)    # nothing dropped or invented
+    measured = {"sft_350m_guard", "__headline__"}
+    starved = [k for k in base
+               if k not in measured and k != "calibration"]
+    # every starved phase (incl. the skipped + timed-out ones) runs
+    # before anything measured in round 1...
+    assert max(order.index(k) for k in starved) \
+        < min(order.index(k) for k in measured)
+    # ...and starved phases keep their cheap-first relative order
+    assert [k for k in order if k in starved] \
+        == [k for k in base if k in starved]
+
+    # round 2 measures what starved; round 3 then prioritizes round 1's
+    # leaders again — full rotation, every phase measured every K rounds
+    r2 = {k: {"ok": 1} for k in starved}
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(r2))
+    order3 = [k for k, _, _ in bench._phase_order(bench.PHASES)]
+    assert order3.index("sft_350m_guard") \
+        < min(order3.index(k) for k in starved)
+
+
 def test_bench_interrupt_emits_partial_record(tmp_path):
     """SIGINT mid-suite (a user's Ctrl-C, or a wrapping driver giving up):
     the parent must still emit the driver-contract JSON with every

@@ -1,0 +1,395 @@
+"""Paged-KV serving tests (``inference/serving/paging.py``,
+``docs/serving.md`` "Paged KV cache").
+
+The paged acceptance contract: with the slot lanes replaced by a shared
+page pool + block tables, greedy serving outputs stay BITWISE-identical
+to solo ``generate()`` runs, tokens are invariant to the page size, a
+shared prompt prefix is prefilled exactly once (copy-on-write at page
+granularity), pool exhaustion degrades into admission backpressure
+(``QueueFull`` / stalls — never corruption), paged snapshots
+preempt→restore bitwise, and the whole lifecycle still mints exactly ONE
+decode executable per server."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.serving.paging import (PagePool, PrefixIndex,
+                                                    compact_page_str,
+                                                    expand_page_str)
+from deepspeed_tpu.inference.serving.slo import QueueFull, RequestStatus
+from deepspeed_tpu.models.transformer import Transformer, TransformerConfig
+
+
+def tiny_cfg(**over):
+    base = dict(vocab_size=97, hidden_size=64, num_layers=2, num_heads=4,
+                max_seq_len=64, use_flash_attention=False, dtype="float32")
+    base.update(over)
+    return TransformerConfig(**base)
+
+
+PAGED = {"enabled": True, "num_slots": 3, "max_cache_len": 64,
+         "prefill_chunk": 8, "prefill_token_budget": 16,
+         "decode_block": 2, "paged": True, "page_size": 16}
+
+
+def _build_engine(model_cfg=None, serving=None):
+    model = Transformer(model_cfg or tiny_cfg())
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 97, (2, 12)),
+                      jnp.int32)
+    params = model.init(jax.random.key(0), {"input_ids": ids})
+    eng = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32", "prefill_chunk_size": 8,
+                       "serving": serving or PAGED})
+    eng.set_params(params)
+    return eng
+
+
+@pytest.fixture(scope="module")
+def paged_engine():
+    return _build_engine()
+
+
+def _mixed_workload(rng, n=7):
+    lens = rng.integers(9, 21, (n,))
+    news = rng.integers(3, 13, (n,))
+    prompts = [rng.integers(1, 97, (int(p),)).astype(np.int32)
+               for p in lens]
+    return prompts, [int(x) for x in news]
+
+
+def _assert_bitwise(eng, outs, rids, prompts, news, eos=None):
+    for i, (rid, p, n) in enumerate(zip(rids, prompts, news)):
+        e = -1 if eos is None else eos[i]
+        want = np.asarray(eng.generate(p[None], max_new_tokens=n,
+                                       eos_token_id=e))[0]
+        np.testing.assert_array_equal(
+            outs[rid], want,
+            err_msg=f"request {rid} (P={len(p)}, new={n}) diverges from "
+                    f"its solo generate() run")
+
+
+def test_paged_serving_matches_solo_generate(paged_engine):
+    """The PR 4 equivalence contract in paged mode: num_slots(3) <
+    num_requests(7), mid-stream EOS retirements, slot churn — every
+    output bitwise-equal to solo generate(), ONE decode executable."""
+    eng = paged_engine
+    rng = np.random.default_rng(3)
+    prompts, news = _mixed_workload(rng)
+    eos_ids = []
+    for i, (p, n) in enumerate(zip(prompts, news)):
+        if i % 2 == 0:
+            probe = np.asarray(eng.generate(p[None], max_new_tokens=n))[0]
+            eos_ids.append(int(probe[len(p) + n // 2]))
+        else:
+            eos_ids.append(-1)
+    srv = eng.serve()
+    assert srv.paged and srv.page == 16
+    rids = [srv.submit(p, max_new_tokens=n, eos_token_id=e)
+            for p, n, e in zip(prompts, news, eos_ids)]
+    outs = srv.drain()
+    assert sorted(outs) == sorted(rids)
+    _assert_bitwise(eng, outs, rids, prompts, news, eos_ids)
+    # every slot's pages returned to the pool; only the prefix index may
+    # still hold references
+    assert not srv._slot_pages
+    assert (srv._page_table == 0).all()
+    n_decode_sigs = sum(1 for sig in eng._aot
+                        if sig and sig[0] == id(srv._decode_fn))
+    assert n_decode_sigs == 1, n_decode_sigs
+
+
+def test_paged_page_size_invariance(paged_engine):
+    """Same tokens for page_size in {16, 64, 128}: the page size only
+    changes where K/V rows physically live, never what is attended."""
+    eng = paged_engine
+    rng = np.random.default_rng(5)
+    prompts, news = _mixed_workload(rng, n=5)
+    ref = None
+    for ps in (16, 64, 128):
+        srv = eng.serve(page_size=ps)
+        rids = [srv.submit(p, max_new_tokens=n)
+                for p, n in zip(prompts, news)]
+        outs = srv.drain()
+        got = [outs[r] for r in rids]
+        if ref is None:
+            ref = got
+            _assert_bitwise(eng, outs, rids, prompts, news)
+        else:
+            for a, b in zip(ref, got):
+                np.testing.assert_array_equal(a, b)
+
+
+def test_paged_prefix_cow_divergence(paged_engine):
+    """Copy-on-write prefix sharing: requests with a common 2-page
+    prefix and divergent tails share the prefix pages (prefilled once —
+    later admissions hit the index) yet produce bitwise-solo outputs;
+    the divergent tail re-prefills at most one page of tokens."""
+    eng = paged_engine
+    rng = np.random.default_rng(11)
+    pre = rng.integers(1, 97, (32,)).astype(np.int32)      # 2 full pages
+    reqs = [np.concatenate([pre,
+                            rng.integers(1, 97, (5,)).astype(np.int32)])
+            for _ in range(4)]
+    srv = eng.serve()
+    rids = [srv.submit(q, max_new_tokens=6) for q in reqs]
+    outs = srv.drain()
+    _assert_bitwise(eng, outs, rids, reqs, [6] * 4)
+    # request 1..3 each matched the 2 shared pages request 0 registered
+    assert srv.stats["prefix_hits"] >= 3, srv.stats
+    assert srv.stats["prefix_tokens_reused"] >= 3 * 32
+    # the shared prefill really was skipped: without sharing 4 requests
+    # of 37 tokens cost 4*ceil(37/8)*8 = 160 prefill tokens; with 2
+    # shared pages the 3 hits each saved 32 tokens
+    assert srv.stats["prefill_tokens"] <= 160 - 3 * 32
+
+
+def test_paged_prefix_chunk_unaligned_boundary():
+    """A prefix match whose page boundary is NOT chunk-aligned must be
+    rounded DOWN to a chunk-aligned start: chunk ci writes the full
+    padded span [s0+ci*C, s0+(ci+1)*C), so a page-aligned-only s0 can
+    pad past the table row (page 16, chunk 64, P=120, m=7 matched pages:
+    112 + 64 = 176 > the 8-page lane — a host-side broadcast crash
+    mid-admission before the fix).  Outputs stay bitwise-solo."""
+    eng = _build_engine(
+        model_cfg=tiny_cfg(max_seq_len=128),
+        serving={"enabled": True, "num_slots": 2, "max_cache_len": 128,
+                 "prefill_chunk": 64, "prefill_token_budget": 128,
+                 "decode_block": 2, "paged": True, "page_size": 16})
+    eng._config.prefill_chunk_size = 64      # solo replays the same chunk
+    rng = np.random.default_rng(31)
+    p = rng.integers(1, 97, (120,)).astype(np.int32)
+    want = np.asarray(eng.generate(p[None], max_new_tokens=8))[0]
+    srv = eng.serve()
+    r1 = srv.submit(p, max_new_tokens=8)     # registers the prefix
+    outs = srv.drain()
+    r2 = srv.submit(p, max_new_tokens=8)     # matches 7 pages -> round to 4
+    outs.update(srv.drain())
+    np.testing.assert_array_equal(outs[r1], want)
+    np.testing.assert_array_equal(outs[r2], want)
+    assert srv.stats["prefix_hits"] == 1
+    # the trimmed match really started the second prefill chunk-aligned
+    assert srv.stats["prefix_tokens_reused"] == 64
+
+
+def test_paged_prefix_stats_count_admissions_not_stalls():
+    """Prefix stats count ADMISSIONS: a request stalled at the queue
+    head under pool pressure retries _start_prefill_paged every step and
+    must not record a lookup/hit per retry (hit-rate inflation)."""
+    eng = _build_engine()
+    rng = np.random.default_rng(37)
+    pre = rng.integers(1, 97, (32,)).astype(np.int32)      # 2 pages
+    reqs = [np.concatenate([pre,
+                            rng.integers(1, 97, (5,)).astype(np.int32)])
+            for _ in range(4)]
+    # 4 allocatable pages vs 3 pages/request: concurrency is page-bound,
+    # so admissions stall while earlier requests decode
+    srv = eng.serve(num_pages=5)
+    rids = [srv.submit(q, max_new_tokens=6) for q in reqs]
+    outs = srv.drain()
+    assert srv.stats["admission_stalls"] > 0
+    assert srv.stats["prefix_lookups"] == 4, srv.stats
+    _assert_bitwise(eng, outs, rids, reqs, [6] * 4)
+
+
+def test_paged_pool_exhaustion_backpressure(paged_engine):
+    """Refcount/pool exhaustion shows up as admission BACKPRESSURE —
+    a bounded queue rejects with QueueFull, an unbounded one stalls
+    admission until retirements free pages — and everything admitted
+    still completes bitwise-correct (no corruption, no deadlock)."""
+    eng = paged_engine
+    rng = np.random.default_rng(13)
+    prompts, news = _mixed_workload(rng, n=8)
+
+    # bounded queue: pool of 8 allocatable pages fills, queue backs up,
+    # submit() rejects with QueueFull
+    srv = eng.serve(num_pages=9, max_queue_depth=2, queue_policy="reject")
+    accepted = []
+    with pytest.raises(QueueFull):
+        for i in range(8):
+            accepted.append(
+                (srv.submit(prompts[i], max_new_tokens=news[i]), i))
+    outs = srv.drain()
+    _assert_bitwise(eng, outs, [r for r, _ in accepted],
+                    [prompts[i] for _, i in accepted],
+                    [news[i] for _, i in accepted])
+
+    # unbounded queue: admission stalls at the queue head under pool
+    # pressure and resumes as slots retire — all 8 complete.  3
+    # allocatable pages vs (mostly) 2-page requests: two can never run
+    # concurrently even though 3 slots are free
+    srv = eng.serve(num_pages=4, prefix_cache=False)
+    rids = [srv.submit(p, max_new_tokens=n)
+            for p, n in zip(prompts, news)]
+    outs = srv.drain()
+    assert srv.stats["admission_stalls"] > 0
+    _assert_bitwise(eng, outs, rids, prompts, news)
+    # nothing leaked: the pool drains back to empty
+    assert srv._pool.in_use == 0
+
+    # a request the pool can NEVER hold is rejected at submit, not
+    # queued into a deadlock
+    with pytest.raises(ValueError, match="pages"):
+        srv.submit(rng.integers(1, 97, (40,)).astype(np.int32),
+                   max_new_tokens=20)
+
+
+def test_paged_preempt_restore_bitwise(paged_engine, tmp_path):
+    """Graceful preemption of a paged server: snapshot mid-flight,
+    restore on a fresh paged server, stitched outputs bitwise-identical
+    to uninterrupted runs; the snapshot stores page tables as compact
+    range strings (diagnostics), never one JSON int per entry."""
+    import json
+    import os
+    eng = paged_engine
+    rng = np.random.default_rng(17)
+    prompts, news = _mixed_workload(rng, n=6)
+    srv = eng.serve()
+    rids = [srv.submit(p, max_new_tokens=n)
+            for p, n in zip(prompts, news)]
+    outs = {}
+    for _ in range(4):
+        outs.update(srv.step())
+    tag, snapped, fin = srv.preempt(str(tmp_path), drain_budget_s=0.0)
+    outs.update(fin)
+    assert snapped, "nothing was left to snapshot — weak test setup"
+    with open(os.path.join(str(tmp_path), tag, "serving_state.json")) as f:
+        state = json.load(f)
+    in_slot = [r for r in state["requests"] if r.get("pages")]
+    assert in_slot, "no in-slot request carried a compact page table"
+    for r in in_slot:
+        assert isinstance(r["pages"], str)
+        assert expand_page_str(r["pages"])          # parses back
+    srv2 = eng.serve()
+    restored = srv2.restore(str(tmp_path))
+    assert sorted(restored) == sorted(snapped)
+    outs.update(srv2.drain())
+    _assert_bitwise(eng, outs, rids, prompts, news)
+
+
+def test_paged_restore_onto_smaller_pool_aborts(paged_engine, tmp_path):
+    """A snapshot from a big-pool server restored onto a server whose
+    pool can never hold a request ABORTs it with a clear reason (the
+    paged mirror of the PR 5 lane-capacity check)."""
+    eng = paged_engine
+    rng = np.random.default_rng(19)
+    big = rng.integers(1, 97, (20,)).astype(np.int32)
+    srv = eng.serve()
+    rid = srv.submit(big, max_new_tokens=20)        # 40 positions
+    srv.preempt(str(tmp_path), drain_budget_s=0.0)
+    srv2 = eng.serve(num_pages=3)                   # 2 pages = 32 positions
+    assert srv2.restore(str(tmp_path)) == []
+    res = srv2.result(rid)
+    assert res.status == RequestStatus.ABORTED
+    assert "page" in res.detail and "num_pages" in res.detail
+
+
+def test_paged_int8_kv_serving_matches_solo(tmp_path):
+    """int8 KV quantization through the paged pool: quantized page
+    writes/gathers reproduce solo generate() (which quantizes the same
+    rows into a monolithic cache) bitwise."""
+    eng = _build_engine(model_cfg=tiny_cfg(kv_cache_quant=True))
+    rng = np.random.default_rng(23)
+    prompts, news = _mixed_workload(rng, n=5)
+    srv = eng.serve()
+    assert "k_scale" in srv._pool_ws.take(srv.num_pages, srv.page,
+                                          eng.compute_dtype)
+    srv._pool_ws.release()
+    rids = [srv.submit(p, max_new_tokens=n)
+            for p, n in zip(prompts, news)]
+    outs = srv.drain()
+    _assert_bitwise(eng, outs, rids, prompts, news)
+
+
+def test_paged_overload_cycle_zero_new_decode_executables(paged_engine,
+                                                         tmp_path):
+    """The zero-new-executables invariant extended to paged mode
+    (acceptance): an overload burst + deadline shed + cancel + preempt +
+    restarted-server resume mints exactly ONE paged decode signature per
+    server — page allocation, sharing, eviction and table churn all ride
+    traced arguments."""
+    eng = paged_engine
+    rng = np.random.default_rng(29)
+    prompts, news = _mixed_workload(rng, n=7)
+    srv1 = eng.serve()
+    rids = [srv1.submit(p, max_new_tokens=n)
+            for p, n in zip(prompts[:5], news[:5])]
+    r_shed = srv1.submit(prompts[5], max_new_tokens=4, deadline_s=0.0)
+    r_cancel = srv1.submit(prompts[6], max_new_tokens=4)
+    srv1.cancel(r_cancel)
+    early = {}
+    for _ in range(4):
+        early.update(srv1.step())
+    tag, snapped, fin = srv1.preempt(str(tmp_path), drain_budget_s=0.0)
+    early.update(fin)
+    assert srv1.result(r_shed).status == RequestStatus.SHED_DEADLINE
+    assert srv1.result(r_cancel).status == RequestStatus.CANCELLED
+    srv2 = eng.serve()
+    restored = srv2.restore(str(tmp_path))
+    assert sorted(restored) == sorted(snapped)
+    outs = dict(early)
+    outs.update(srv2.drain())
+    _assert_bitwise(eng, outs, rids, prompts[:5], news[:5])
+    for srv in (srv1, srv2):
+        n_decode = sum(1 for sig in eng._aot
+                       if sig and sig[0] == id(srv._decode_fn))
+        assert n_decode == 1, n_decode
+
+
+def test_paged_default_off_and_validation():
+    """serving.paged defaults OFF (seed behavior: monolithic lanes,
+    no pool attributes consulted), and bad paged configs fail loudly."""
+    from deepspeed_tpu.inference.serving.config import ServingConfig
+    assert ServingConfig().paged is False
+    eng = _build_engine(serving={**PAGED, "paged": False})
+    srv = eng.serve()
+    assert not srv.paged and not hasattr(srv, "_pool")
+    with pytest.raises(ValueError, match="num_pages"):
+        _build_engine(serving={**PAGED, "num_pages": 1}).serve()
+
+
+def test_page_pool_and_prefix_index_unit():
+    """Host bookkeeping invariants: trash page pinned, refcounted
+    alloc/free, chain-hash lookup/register, leaf-first LRU eviction,
+    and the compact page-string round trip."""
+    pool = PagePool(6)                       # pages 1..5 allocatable
+    assert pool.allocatable == 5 and pool.free_count == 5
+    got = pool.alloc(3)
+    assert got is not None and 0 not in got
+    assert pool.alloc(3) is None             # never a partial grab
+    pool.incref(got[0])
+    for p in got:
+        pool.decref(p)
+    assert pool.free_count == 4              # got[0] still referenced
+    pool.decref(got[0])
+    assert pool.free_count == 5
+
+    idx = PrefixIndex()
+    toks = np.arange(32, dtype=np.int32)
+    row = pool.alloc(2)
+    assert idx.register(toks, 16, row, pool, 2) == 2
+    for p in row:
+        pool.decref(p)          # the registering slot retires — only the
+    hit = idx.lookup(toks, 16, pool, 2)     # index's references remain
+    assert hit == row
+    for p in hit:
+        pool.decref(p)
+    # divergence INSIDE block 2: only block 1 matches
+    toks2 = toks.copy()
+    toks2[20] = 96
+    hit2 = idx.lookup(toks2, 16, pool, 2)
+    assert hit2 == row[:1]
+    for p in hit2:
+        pool.decref(p)
+    # eviction is leaf-first: the chain's tail goes before its parent
+    assert idx.evict(pool, 1) == 1
+    assert len(idx) == 1 and pool.refcount(row[1]) == 0
+    idx.clear(pool)
+    assert pool.free_count == 5
+
+    assert compact_page_str([4, 5, 6, 9, 2]) == "4-6,9,2"
+    assert expand_page_str("4-6,9,2") == [4, 5, 6, 9, 2]
+    assert compact_page_str([]) == "" and expand_page_str("") == []

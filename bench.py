@@ -1182,28 +1182,62 @@ PHASE_TIMEOUT_SCALE = {
 # with 3/10 phases, five rounds running).
 # --------------------------------------------------------------------- #
 
+def _normalize_record(rec):
+    """A usable final-format record from whatever shape a ``BENCH_r*.json``
+    arrived in, or None.
+
+    The driver may publish either the final record itself or a wrapper
+    ``{n, cmd, rc, tail, parsed}`` around the run — in the wrapper the
+    record is ``parsed`` (when the driver decoded it) or the LAST stdout
+    line captured in ``tail`` (``main()`` prints the final record as one
+    JSON line).  A tail truncated mid-record is unrecoverable: return
+    None and let callers walk to an older round."""
+    if not isinstance(rec, dict):
+        return None
+    if not ("rc" in rec and ("tail" in rec or "cmd" in rec)):
+        return rec                               # already final-format
+    parsed = rec.get("parsed")
+    if isinstance(parsed, dict):
+        return parsed
+    tail = rec.get("tail") or ""
+    for line in reversed(tail.rstrip().splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                return None                      # clipped mid-record
+    return None
+
+
 def _round_trail():
     """Previous rounds' final records (``BENCH_r*.json`` next to this
     file / in ``BENCH_OUT_DIR``), oldest first — the driver publishes one
-    per round.  Unreadable files are skipped (a partial record must never
-    wedge scheduling)."""
+    per round.  Unreadable/unrecoverable files are skipped (a partial
+    record must never wedge scheduling)."""
     import glob
     recs = []
     for p in sorted(glob.glob(os.path.join(_out_dir(), "BENCH_r*.json"))):
         try:
             with open(p) as f:
-                recs.append(json.load(f))
+                rec = _normalize_record(json.load(f))
         except (OSError, ValueError):
             continue
+        if rec is not None:
+            recs.append(rec)
     return recs
+
+
+def _REC_KEY(key):
+    """Phase key -> final-record key (the headline phase is published
+    under ``north_star``)."""
+    return "north_star" if key == "__headline__" else key
 
 
 def _phase_measured(rec, key):
     """True when ``rec`` holds a COMPLETED measurement for the phase —
     skipped / timed-out / errored entries don't count (that phase is
     still starving)."""
-    k = "north_star" if key == "__headline__" else key
-    ph = rec.get(k)
+    ph = rec.get(_REC_KEY(key))
     return isinstance(ph, dict) and ph \
         and not any(t in ph for t in ("skipped", "timeout", "error"))
 
@@ -1230,6 +1264,72 @@ def _phase_order(phases):
     rest = sorted((p for p in phases if p[1] != "calibrate"),
                   key=lambda p: (-staleness(p[0]), index[p[0]]))
     return [p for p in phases if p[1] == "calibrate"] + rest
+
+
+# --------------------------------------------------------------------- #
+# Per-phase regression thresholds against the previous round's record
+# (warn-and-annotate — ROADMAP item 5: the perf trajectory must flag its
+# own cliffs, not wait for a human to diff BENCH_r* files by eye)
+# --------------------------------------------------------------------- #
+
+def _regression_direction(key):
+    """+1 = higher is better, -1 = lower is better, 0 = not a perf metric."""
+    if "tokens_per_sec" in key or "tok_s" in key or key == "mfu" \
+            or key.startswith("speedup") or key.endswith("_efficiency"):
+        return 1
+    if key in ("step_time_s", "e2e_time_s") or key.startswith("ttft_"):
+        return -1
+    return 0
+
+
+def _walk_metrics(d, path=""):
+    for k, v in d.items():
+        p = f"{path}.{k}" if path else k
+        if isinstance(v, dict):
+            yield from _walk_metrics(v, p)
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            yield p, k, v
+
+
+def _annotate_regressions(key, phase, trail=None, threshold=None):
+    """Compare this phase's perf metrics against the newest previous
+    ``BENCH_r*`` record that measured it; annotate drops beyond the
+    threshold in the phase record (``phase["regressions"]``) and warn.
+    Never fails the run — the record is the alarm, the bench keeps
+    measuring (a regressed phase is exactly the one worth re-measuring
+    next round)."""
+    if not isinstance(phase, dict) or \
+            any(t in phase for t in ("skipped", "timeout", "error")):
+        return
+    if threshold is None:
+        threshold = float(os.environ.get("BENCH_REGRESSION_THRESHOLD",
+                                         "0.15"))
+    if threshold <= 0:
+        return
+    trail = _round_trail() if trail is None else trail
+    prev = next((rec[_REC_KEY(key)] for rec in reversed(trail)
+                 if _phase_measured(rec, key)), None)
+    if not isinstance(prev, dict):
+        return
+    prev_flat = {p: v for p, _, v in _walk_metrics(prev)}
+    regs = []
+    for path, leaf, now in _walk_metrics(phase):
+        d = _regression_direction(leaf)
+        old = prev_flat.get(path)
+        if not d or not isinstance(old, (int, float)) or old <= 0 or now <= 0:
+            continue
+        ratio = now / old if d > 0 else old / now
+        if ratio < 1.0 - threshold:
+            regs.append({"metric": path, "prev": old, "now": now,
+                         "drop_pct": round((1.0 - ratio) * 100, 1)})
+    if regs:
+        regs.sort(key=lambda r: -r["drop_pct"])
+        phase["regressions"] = regs
+        worst = regs[0]
+        print(f"bench: REGRESSION in phase {key}: {len(regs)} metric(s) "
+              f"beyond the {threshold:.0%} threshold vs the previous "
+              f"record (worst: {worst['metric']} {worst['prev']} -> "
+              f"{worst['now']}, -{worst['drop_pct']}%)", file=sys.stderr)
 
 
 def run_phase(name, fallback, out_path):
@@ -1388,6 +1488,10 @@ def main():
     errors = {}
     extra_env = {}
     suite_t0 = time.perf_counter()
+    # previous rounds' records, read once: the per-phase regression
+    # thresholds (warn-and-annotate) compare against the newest record
+    # that measured each phase
+    trail = _round_trail()
 
     phases = PHASES
     if suite_budget:
@@ -1464,6 +1568,7 @@ def main():
                     print(f"bench: phase {name} failed twice — recording "
                           f"the error and continuing", file=sys.stderr)
             phase["phase_wall_s"] = round(wall, 1)
+            _annotate_regressions(key, phase, trail=trail)
             if key == "calibration" and "measured_mxu_tflops" in phase:
                 # anchor later phases' roofline math to the measured peaks —
                 # but ONLY when they are physically plausible: tunnel jitter

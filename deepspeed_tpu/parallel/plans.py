@@ -1,0 +1,193 @@
+"""Canonical tiny sharding-plan builders for the static collective-schedule
+gate (``tools/lint/contract.py``).
+
+Each builder constructs the SAME plan family the MULTICHIP dry-run exercises
+(``__graft_entry__._run_dryrun_phases``: ZeRO-3 + tp + sp, MoE expert
+parallelism, 1F1B pipeline x tp, MiCS hierarchical ZeRO) at toy sizes on the
+8-virtual-device CPU mesh, and returns the jitted fused train step plus
+concrete args — so the contract analyzer can compile it once and COUNT the
+collective ops XLA actually scheduled.  Locking those counts in
+``PROGRAMS.lock`` turns the dry-run's re-measured collective totals into a
+static, diffable artifact: a sharding-plan change that silently adds an
+all-gather (or drops the Ulysses all-to-all) fails the tier-1 gate with a
+per-plan diff instead of surfacing as a multichip perf cliff.
+
+Builders are self-contained and deterministic (fixed seeds, fixed shapes);
+they require ``jax.device_count() >= 8`` (the tier-1 harness forces 8
+virtual CPU devices; the ``ds_lint --contracts`` CLI does the same).
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class PlanProgram:
+    """One sharding plan's fused step, ready to lower/compile.
+
+    ``expect`` names the collectives the plan MUST schedule (sanity
+    invariants, checked on top of the exact locked counts): e.g. ZeRO-3
+    must all-gather params, a pipeline must collective-permute at stage
+    boundaries.  ``reduction`` plans additionally require at least one of
+    all-reduce / reduce-scatter (XLA picks per shape)."""
+    name: str
+    fn: Callable
+    args: Tuple[Any, ...]
+    mesh: Dict[str, int]
+    expect: Tuple[str, ...] = ()
+    reduction: bool = True
+
+
+def _tiny_cfg(**over):
+    from deepspeed_tpu.models.transformer import TransformerConfig
+    base = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                max_seq_len=32, dtype="float32", use_flash_attention=False,
+                remat=False)
+    base.update(over)
+    return TransformerConfig(**base)
+
+
+def _fused_step_args(engine, batch):
+    """(fused_step, args) for a lazily-initialized DeepSpeedEngine —
+    the exact per-step program ``train_batch`` dispatches."""
+    import jax
+    import jax.numpy as jnp
+    fused = engine._get_fused_step()
+    args = (engine._params, engine._opt_state, engine._scaler_state,
+            jnp.asarray(1e-3, jnp.float32), jnp.asarray(1, jnp.int32),
+            engine._rng, jax.tree.map(jnp.asarray, batch))
+    return fused, args
+
+
+def zero3_tp_sp():
+    """ZeRO-3 param sharding + Megatron tp=2 + Ulysses sp=2 over dp=2:
+    param all-gathers, grad reduction, and the sp head/seq all-to-all."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import Transformer
+    rng = np.random.default_rng(0)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=Transformer(_tiny_cfg(max_seq_len=64)),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 3},
+                "gradient_clipping": 1.0,
+                "tensor_parallel": {"tp_size": 2},
+                "sequence_parallel": {"sp_size": 2}})
+    batch = {"input_ids": rng.integers(0, 64, (2, 2, 64)).astype(np.int32)}
+    micro = {"input_ids": batch["input_ids"][0]}
+    engine._lazy_init((micro,), {})
+    fn, args = _fused_step_args(engine, batch)
+    return PlanProgram("parallel.zero3_tp_sp", fn, args,
+                       mesh=dict(engine.mesh.shape),
+                       expect=("all-gather", "all-to-all"))
+
+
+def moe_ep():
+    """Expert parallelism: experts sharded over ep=2, GShard
+    dispatch/combine einsums, expert-data-parallel gradient semantics
+    (ZeRO-2).  The dispatch is the einsum formulation
+    (``moe/sharded_moe.py``), so GSPMD picks the collective: at this toy
+    config XLA lowers it through all-gathers rather than an explicit
+    all-to-all — the locked counts pin whichever schedule it chose, which
+    is exactly what the gate is for (a strategy flip on a jax/XLA bump
+    shows up as a readable diff, not a multichip surprise)."""
+    import jax
+    import jax.numpy as jnp
+    import flax.linen as nn
+    import deepspeed_tpu
+    from deepspeed_tpu.moe.layer import MoE
+
+    class MoELM(nn.Module):
+        @nn.compact
+        def __call__(self, batch):
+            ids = batch["input_ids"]
+            h = nn.Embed(64, 32, param_dtype=jnp.float32)(ids)
+            y, aux, _ = MoE(hidden_size=32, num_experts=4, ep_size=2,
+                            k=1, capacity_factor=2.0, dtype=jnp.float32,
+                            name="moe")(h)
+            h = h + y
+            logits = nn.Dense(64)(h)
+            tgt = jnp.pad(ids[:, 1:], ((0, 0), (0, 1)))
+            ce = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits)
+                                   * jax.nn.one_hot(tgt, 64), -1))
+            return ce + 0.01 * aux
+
+    rng = np.random.default_rng(1)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=MoELM(),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "moe": {"ep_size": 2},
+                "zero_optimization": {"stage": 2}})
+    batch = {"input_ids": rng.integers(0, 64, (1, 8, 16)).astype(np.int32)}
+    micro = {"input_ids": batch["input_ids"][0]}
+    engine._lazy_init((micro,), {})
+    fn, args = _fused_step_args(engine, batch)
+    return PlanProgram("parallel.moe_ep", fn, args,
+                       mesh=dict(engine.mesh.shape))
+
+
+def pipeline_1f1b():
+    """pp=2 x tp=2 interleaved 1F1B: stage-boundary activations ride
+    collective-permute; tp adds Megatron all-reduces."""
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models.pipeline_transformer import transformer_pipe
+    rng = np.random.default_rng(2)
+    pipe_module = transformer_pipe(_tiny_cfg(
+        num_layers=4, scan_layers=False, pre_layer_norm=False,
+        embed_proj_dim=32, tie_word_embeddings=True))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=pipe_module,
+        config={"train_micro_batch_size_per_gpu": 2,
+                # M=4 > P=2 so the interleaved schedule's steady state
+                # genuinely executes (same contract as the dry-run)
+                "gradient_accumulation_steps": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "tensor_parallel": {"tp_size": 2},
+                "pipeline": {"stages": 2, "schedule": "1f1b"}})
+    batch = jax.tree.map(
+        jnp.asarray,
+        {"input_ids": rng.integers(0, 64, (4, 2, 32)).astype(np.int32)})
+    engine._lazy_init_pipe(batch)
+    fused = engine._get_fused_step()
+    args = (engine._params, engine._opt_state, engine._scaler_state,
+            jnp.asarray(1e-4, jnp.float32), jnp.asarray(1, jnp.int32),
+            engine._rng, batch)
+    return PlanProgram("parallel.pipeline_1f1b", fused, args,
+                       mesh=dict(engine.mesh.shape),
+                       expect=("collective-permute",))
+
+
+def mics():
+    """MiCS hierarchical ZeRO-3 + tp=2: params shard within edp=2 groups
+    (ICI-local all-gather) and grads reduce across mdp x edp."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import Transformer
+    rng = np.random.default_rng(3)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=Transformer(_tiny_cfg()),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "bf16": {"enabled": True},
+                "tensor_parallel": {"tp_size": 2},
+                "zero_optimization": {"stage": 3, "mics_shard_size": 2}})
+    dp_world = engine.topology.mdp * engine.topology.edp
+    batch = {"input_ids": rng.integers(0, 64, (1, dp_world, 32))
+             .astype(np.int32)}
+    micro = {"input_ids": batch["input_ids"][0]}
+    engine._lazy_init((micro,), {})
+    fn, args = _fused_step_args(engine, batch)
+    return PlanProgram("parallel.mics", fn, args,
+                       mesh=dict(engine.mesh.shape),
+                       expect=("all-gather",))
+
+
+PLAN_BUILDERS = (zero3_tp_sp, moe_ep, pipeline_1f1b, mics)

@@ -460,7 +460,7 @@ class DeepSpeedEngine:
         """Shard a host batch across the DP (and sp) mesh axes."""
         def put(x):
             x = jnp.asarray(x) if not isinstance(x, jax.Array) else x
-            if x.ndim == 0:
+            if x.ndim == 0:  # tpu-lint: disable=TL006 -- rank probe for scalar placement; a workload's batch ranks are fixed, not per-step drift
                 return jax.device_put(x, NamedSharding(self.mesh, P()))
             return jax.device_put(x, self._data_sharding(x.ndim))
         return jax.tree.map(put, batch)

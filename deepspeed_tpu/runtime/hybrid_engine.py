@@ -221,7 +221,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                 verdict = False
                 break
             sh = getattr(leaf, "sharding", None)
-            if sh is None or not sh.is_equivalent_to(want, leaf.ndim):
+            if sh is None or not sh.is_equivalent_to(want, leaf.ndim):  # tpu-lint: disable=TL006 -- one-time placement verdict, memoized in _view_identity (donating updates preserve dtype/sharding)
                 verdict = False
                 break
         self._view_identity = verdict

@@ -10,12 +10,20 @@ Rules (each suppressible per line or per function via
   jitted function
 * **TL004** unhashable or array-valued static args
 * **TL005** per-step config/dict string lookups on a hot path
+* **TL006** jit-signature instability (weak-typed scalars into traced
+  positions, identity-hashed statics, shape-dependent host branches) —
+  paired with the runtime retrace counter
+  (:mod:`deepspeed_tpu.tools.lint.retrace_check`)
+* **TL007** variable read after being passed in a donated position
 
 CLI: ``python -m deepspeed_tpu.tools.lint [paths]`` (or ``bin/ds_lint``);
-exits non-zero when any unsuppressed finding remains.  The companion jaxpr
-harness (:mod:`deepspeed_tpu.tools.lint.jaxpr_check`) traces registered
-runtime/inference entry points and verifies — at the compiler level — that
-they contain no host callbacks and that declared donations actually alias.
+exits non-zero when any unsuppressed finding remains.  ``--jaxpr`` runs
+the companion jaxpr harness (:mod:`deepspeed_tpu.tools.lint.jaxpr_check`),
+which traces the registered hot-path entry points and verifies — at the
+compiler level — that they contain no host callbacks and that declared
+donations actually alias.  ``--contracts [--update]`` regenerates the
+program-contract lockfile (:mod:`deepspeed_tpu.tools.lint.contract`,
+``PROGRAMS.lock``) and diffs it per program.
 """
 
 from deepspeed_tpu.tools.lint.core import Finding, RULES, run_lint  # noqa: F401

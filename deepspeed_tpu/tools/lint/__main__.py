@@ -21,7 +21,30 @@ def main(argv=None):
     parser.add_argument("--json", action="store_true",
                         help="emit findings as JSON")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--jaxpr", action="store_true",
+                        help="run the jaxpr-level entry-point harness "
+                             "(traces the registered hot paths on CPU; "
+                             "no host callbacks, donations alias)")
+    parser.add_argument("--contracts", action="store_true",
+                        help="regenerate every program contract and diff "
+                             "against PROGRAMS.lock (exit 1 on a break)")
+    parser.add_argument("--update", action="store_true",
+                        help="with --contracts: rewrite PROGRAMS.lock "
+                             "from the freshly extracted contracts")
     args = parser.parse_args(argv)
+
+    if args.update and not args.contracts:
+        print("tpu-lint: error: --update only applies to --contracts",
+              file=sys.stderr)
+        return 2
+    if args.contracts:
+        from deepspeed_tpu.tools.lint import contract
+        contract.ensure_harness_env()
+        return contract.main(update=args.update)
+    if args.jaxpr:
+        from deepspeed_tpu.tools.lint import contract, jaxpr_check
+        contract.ensure_harness_env()
+        return jaxpr_check.main()
 
     if args.list_rules:
         from deepspeed_tpu.tools.lint import rules as _r  # noqa: F401

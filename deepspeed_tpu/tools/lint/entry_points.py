@@ -273,11 +273,45 @@ def serving_admit_paged():
                       expect_donation=True)
 
 
+def hybrid_rollout():
+    """The hybrid engine's rollout generation program (RLHF: decode over
+    the live training weights' inference view) — same jitted body as
+    ``inference.decode`` (``make_generate_fn``) but built through
+    ``DeepSpeedHybridEngine._get_rollout_fn`` with the rollout view as
+    params; the KV cache is donated through it."""
+    import deepspeed_tpu
+    from deepspeed_tpu.inference.engine import (KVCacheWorkspace,
+                                                required_cache_len)
+    from deepspeed_tpu.models.transformer import (Transformer,
+                                                  TransformerConfig)
+    cfg = TransformerConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                            num_heads=4, max_seq_len=64,
+                            use_flash_attention=False, dtype="float32")
+    engine, *_ = deepspeed_tpu.initialize(
+        model=Transformer(cfg),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0},
+                "hybrid_engine": {"enabled": True}})
+    B, P, T = 1, 8, 4
+    ids = jnp.asarray(np.random.default_rng(5).integers(0, 97, (B, P)),
+                      jnp.int32)
+    key = (P, T, False, 1.0, 0, 1.0, False, None,
+           engine._rollout_early_exit)
+    fn = engine._get_rollout_fn(key)
+    params = engine._inference_view()
+    cache = KVCacheWorkspace(engine.module).take(
+        B, required_cache_len(P, T, None), engine.compute_dtype)
+    args = (params, cache, ids, jax.random.key(0), jnp.asarray(-1))
+    return EntryPoint("hybrid.rollout", fn, args, expect_donation=True)
+
+
 BUILDERS = (runtime_train_step, runtime_apply_update, inference_decode,
             inference_prefill_chunk, serving_decode_step,
             serving_admission_prefill, serving_admit,
             serving_decode_step_paged, serving_admission_prefill_paged,
-            serving_admit_paged)
+            serving_admit_paged, hybrid_rollout)
 
 
 def iter_entry_points():

@@ -1,0 +1,110 @@
+"""Runtime retrace counter for the registered serving hot paths.
+
+The static TL006 rule catches jit-signature instability it can SEE in
+source; this harness catches the drift it can't: build a real serving
+engine, dispatch its programs across several rounds of DRIFTING host
+bookkeeping (different prompts, prompt lengths, request ids, client ids,
+deadlines, submit order — everything the host is allowed to vary), and
+count what actually compiled.  The contract: the serving decode / admit /
+admission-prefill programs each compile EXACTLY ONCE per server lifetime,
+no matter how the host-side bookkeeping moves — one new abstract signature
+anywhere in the dispatch path (a weak-typed scalar that used to be an
+array, a shape that started drifting with queue depth) shows up here as a
+second signature before it ships as a 30 s mid-serve recompile.
+
+Counting: every serving dispatch routes through
+``InferenceEngine._run_guarded``, which AOT-compiles once per
+``(program, abstract-signature)`` and memoizes in ``engine._aot`` — so the
+number of ``_aot`` signatures per program IS the compile count.  The jit
+fast path's specialization cache (``fn._cache_size()``) is asserted too
+when jax exposes it.
+
+Runs on CPU at toy sizes in tier-1 (``tests/unit/test_tpu_lint.py``);
+``measure_serving_retraces`` is importable for ad-hoc use.
+"""
+
+import numpy as np
+
+
+def _tiny_served_engine(seed=0):
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import (Transformer,
+                                                  TransformerConfig)
+    cfg = TransformerConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                            num_heads=4, max_seq_len=64,
+                            use_flash_attention=False, dtype="float32")
+    model = Transformer(cfg)
+    ids = jnp.asarray(np.random.default_rng(seed).integers(0, 97, (2, 12)),
+                      jnp.int32)
+    params = model.init(jax.random.key(0), {"input_ids": ids})
+    eng = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32", "prefill_chunk_size": 8,
+                       "serving": {"enabled": True, "num_slots": 2,
+                                   "max_cache_len": 48, "prefill_chunk": 8,
+                                   "prefill_token_budget": 16,
+                                   "decode_block": 2}})
+    eng.set_params(params)
+    return eng
+
+
+def _signature_counts(srv):
+    """{program: number of distinct AOT signatures compiled} — the
+    compile count per serving program (see module docstring)."""
+    eng = srv.engine
+    out = {}
+    for label, fn in (("decode", srv._decode_fn), ("admit", srv._admit_fn),
+                      ("chunk", srv._chunk_fn)):
+        n = sum(1 for sig in eng._aot if sig and sig[0] == id(fn))
+        cache_size = getattr(fn, "_cache_size", lambda: None)()
+        if cache_size:                    # jit fast-path specializations
+            n = max(n, cache_size)
+        out[label] = n
+    return out
+
+
+def measure_serving_retraces(rounds=3, seed=0):
+    """Run ``rounds`` serving rounds with drifting host bookkeeping and
+    return ``{"per_round": [counts...], "final": counts}`` where each
+    ``counts`` maps program -> compile count so far.  The invariant under
+    test: every count stays at 1 from round 1 on."""
+    rng = np.random.default_rng(seed)
+    eng = _tiny_served_engine(seed)
+    srv = eng.serve()
+    per_round = []
+    for r in range(rounds):
+        # drifting host bookkeeping: round-varying request count, prompt
+        # lengths/contents, completion lengths, eos ids, client ids,
+        # deadlines, submit order — none of it may reach a traced shape
+        n = 3 + (r % 2)
+        lens = rng.integers(9, 21, (n,))
+        news = rng.integers(3, 9, (n,))
+        for i in range(n):
+            prompt = rng.integers(1, 97, (int(lens[i]),)).astype(np.int32)
+            srv.submit(prompt, max_new_tokens=int(news[i]),
+                       eos_token_id=-1 if i % 2 else 96,
+                       client_id=f"round{r}-client{i}",
+                       deadline_s=None if i % 2 else 600.0 + r)
+        srv.drain()
+        per_round.append(_signature_counts(srv))
+    return {"per_round": per_round, "final": per_round[-1]}
+
+
+def main():
+    result = measure_serving_retraces()
+    ok = True
+    for r, counts in enumerate(result["per_round"], 1):
+        line = ", ".join(f"{k}={v}" for k, v in counts.items())
+        print(f"[retrace] round {r}: {line}")
+        ok = ok and all(v == 1 for v in counts.values())
+    verdict = ("OK — every serving program compiled exactly once" if ok
+               else "RETRACE DRIFT — a serving program compiled more than "
+                    "once (or never)")
+    print(f"[retrace] {verdict}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

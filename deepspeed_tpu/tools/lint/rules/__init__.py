@@ -6,4 +6,6 @@ from deepspeed_tpu.tools.lint.rules import (  # noqa: F401
     tl003_jit_side_effects,
     tl004_bad_static_args,
     tl005_hot_dict_lookup,
+    tl006_retrace_drift,
+    tl007_use_after_donation,
 )

@@ -1,0 +1,208 @@
+"""TL006 — jit-signature instability (retrace drift).
+
+A jitted program's cache key is the abstract signature of its arguments
+plus the hash of its static args.  Three source patterns quietly destabilize
+that key, so a program that should compile ONCE recompiles under drifting
+host bookkeeping (the serving engine's one-decode-executable invariant is
+exactly this bug class away from regressing):
+
+* **Python scalars in traced positions** — a Python ``int``/``float``/
+  ``bool`` literal traces as a *weak-typed* array; call sites that mix
+  scalars with real arrays in the same position split the jit cache in two
+  (weak vs strong type), and the executable compiled for one refuses the
+  other.  Pin the dtype: ``jnp.asarray(x, jnp.int32)``.
+* **identity-hashed static args** — a freshly-constructed object (any
+  call expression that is not a value-semantics constructor) in a
+  ``static_argnums``/``static_argnames`` position hashes by ``id()``:
+  every call builds a new object, every call recompiles.  (Unhashable
+  literals and array-valued statics are TL004's.)
+* **shape-dependent host branches on a hot path** — an ``if``/``while``
+  on ``.shape``/``.ndim``/``len(arg)`` selects a different program per
+  distinct shape.  Deliberate bucketing is fine — suppress with the
+  reason; an unbucketed branch is one odd request away from a 30 s
+  recompile mid-serve.
+
+The static rule is paired with a RUNTIME retrace counter
+(``tools/lint/retrace_check.py``): dispatch the real serving programs for
+several rounds with drifting host bookkeeping and assert each compiled
+exactly once.
+"""
+
+import ast
+
+from deepspeed_tpu.tools.lint.core import Finding, dotted_name, rule
+from deepspeed_tpu.tools.lint.rules.tl002_missing_donation import (
+    JIT_NAMES, jit_decorator_kwargs)
+from deepspeed_tpu.tools.lint.rules.tl004_bad_static_args import (
+    _ARRAY_CTORS, _static_spec)
+
+# value-semantics constructors: hash by content, stable across calls
+_SAFE_STATIC_CTORS = {"tuple", "frozenset", "str", "int", "float", "bool",
+                      "len"}
+
+
+def _is_py_scalar(node):
+    if isinstance(node, ast.UnaryOp) and \
+            isinstance(node.op, (ast.USub, ast.UAdd)):
+        node = node.operand
+    return isinstance(node, ast.Constant) and \
+        isinstance(node.value, (int, float, bool)) and \
+        not isinstance(node.value, str)
+
+
+def _positional_params(fn_node):
+    """Names a positional call argument can bind to, in order."""
+    a = fn_node.args
+    return [p.arg for p in (*a.posonlyargs, *a.args)]
+
+
+def _jitted_callables(module):
+    """Bare name -> (static_nums, static_names, positional_params) for
+    every callable the module jit-wraps: ``x = jax.jit(f, ...)`` bindings
+    and ``@jit``-decorated defs.  ``positional_params`` is None when the
+    wrapped callable's signature is not module-locally resolvable."""
+    defs = {fn.name: fn.node for fn in module.functions}
+    out = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and dotted_name(node.value.func) in JIT_NAMES:
+            nums, names = _static_spec(node.value.keywords) or ((), ())
+            wrapped = node.value.args[0] if node.value.args else None
+            params = None
+            if isinstance(wrapped, ast.Name) and wrapped.id in defs:
+                params = _positional_params(defs[wrapped.id])
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = (nums, names, params)
+    for fn in module.functions:
+        kws = jit_decorator_kwargs(fn.node)
+        if kws is not None:
+            nums, names = _static_spec(kws) or ((), ())
+            out[fn.name] = (nums, names, _positional_params(fn.node))
+    return out
+
+
+def _static_positions(nums, names, params):
+    """All positional indices that are static.  Second value is False when
+    ``static_argnames`` exist but the signature is unknown — positional
+    traced-vs-static can't be decided, so scalar checks must stand down."""
+    if not names:
+        return set(nums), True
+    if params is None:
+        return set(nums), False
+    return set(nums) | {params.index(n) for n in names if n in params}, True
+
+
+def _unstable_static(node):
+    """Why this static-arg expression recompiles every call, or None."""
+    if isinstance(node, ast.Lambda):
+        return "a lambda (hashes by identity -> recompiles every call)"
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        if name in _ARRAY_CTORS:        # TL004 flags arrays already
+            return None
+        if name is None or name.split(".")[-1] not in _SAFE_STATIC_CTORS:
+            return (f"a freshly-constructed object "
+                    f"({name or 'call result'}: hashes by identity -> "
+                    f"recompiles every call)")
+    return None
+
+
+def _shape_probe(test, params):
+    """The shape/ndim/len read inside a branch test, or None.  ``len()``
+    only counts on a function PARAMETER — ``len`` of a host-local list is
+    ordinary bookkeeping, not a shape probe."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Attribute) and node.attr in ("shape", "ndim"):
+            return f".{node.attr}"
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "len" and node.args \
+                and isinstance(node.args[0], ast.Name) \
+                and node.args[0].id in params:
+            return f"len({node.args[0].id})"
+    return None
+
+
+@rule("TL006", "jit-signature instability (retrace drift)")
+def check(module):
+    jitted = _jitted_callables(module)
+
+    # (a) Python scalars in traced positions, (b) identity-hashed statics
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = node.func
+        spec = None
+        cname = None
+        if isinstance(callee, ast.Name) and callee.id in jitted:
+            spec, cname = jitted[callee.id], callee.id
+        elif isinstance(callee, ast.Call) and \
+                dotted_name(callee.func) in JIT_NAMES:
+            # inline jax.jit(f, ...)(args)
+            nums, names = _static_spec(callee.keywords) or ((), ())
+            wrapped = callee.args[0] if callee.args else None
+            params = None
+            if isinstance(wrapped, ast.Name):
+                for fn in module.functions:
+                    if fn.name == wrapped.id:
+                        params = _positional_params(fn.node)
+                        break
+            spec = (nums, names, params)
+            cname = dotted_name(wrapped) if wrapped is not None else "jit"
+        if spec is None:
+            continue
+        nums, names, params = spec
+        static_pos, pos_known = _static_positions(nums, names, params)
+        for i, arg in enumerate(node.args):
+            if i in static_pos:
+                why = _unstable_static(arg)
+                if why:
+                    yield Finding(
+                        "TL006", module.path, arg.lineno, arg.col_offset,
+                        f"static arg {i} of jitted '{cname}' is {why}")
+            elif pos_known and _is_py_scalar(arg):
+                yield Finding(
+                    "TL006", module.path, arg.lineno, arg.col_offset,
+                    f"Python scalar in traced position {i} of jitted "
+                    f"'{cname}' — traces weak-typed; mixed scalar/array "
+                    f"call sites split the jit cache (pin with "
+                    f"jnp.asarray(x, dtype))")
+        for kw in node.keywords:
+            if kw.arg is None:
+                continue
+            if kw.arg in names:
+                why = _unstable_static(kw.value)
+                if why:
+                    yield Finding(
+                        "TL006", module.path, kw.value.lineno,
+                        kw.value.col_offset,
+                        f"static arg '{kw.arg}' of jitted '{cname}' is "
+                        f"{why}")
+            elif _is_py_scalar(kw.value):
+                yield Finding(
+                    "TL006", module.path, kw.value.lineno,
+                    kw.value.col_offset,
+                    f"Python scalar in traced argument '{kw.arg}' of "
+                    f"jitted '{cname}' — traces weak-typed; pin with "
+                    f"jnp.asarray(x, dtype)")
+
+    # (c) shape-dependent host branches on hot paths
+    for fn in module.hot_functions():
+        own = set()
+        for child in ast.walk(fn.node):
+            if child is not fn.node and isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                own.update(ast.walk(child))
+        params = set(fn.params)
+        for node in ast.walk(fn.node):
+            if node in own or not isinstance(node, (ast.If, ast.While)):
+                continue
+            probe = _shape_probe(node.test, params)
+            if probe:
+                yield Finding(
+                    "TL006", module.path, node.lineno, node.col_offset,
+                    f"shape-dependent host branch ({probe}) inside hot "
+                    f"path '{fn.hot_name or fn.name}' — each distinct "
+                    f"shape mints a separate executable; bucket/pad "
+                    f"shapes (suppress with the reason when this IS the "
+                    f"bucketing)")

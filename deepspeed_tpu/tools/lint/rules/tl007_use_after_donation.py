@@ -1,0 +1,184 @@
+"""TL007 — variable read after being passed in a donated position.
+
+``donate_argnums`` hands the input buffer to XLA: after the call the
+Python name still points at a *dead* array whose storage the program
+reused for its outputs.  Reading it afterwards is exactly the bug class
+behind the PR 5 serving-cache corruption — wrong values, cross-lane
+clobbers, or a crash, all nondeterministic because liveness depends on
+scheduling.  The rule runs an intraprocedural dataflow over each
+function:
+
+* a **name** passed in a donated position of a module-locally resolvable
+  donating callable (``x = jax.jit(f, donate_argnums=...)`` bindings,
+  ``@partial(jax.jit, donate_argnums=...)`` defs, inline
+  ``jax.jit(f, ...)(args)``) is CONSUMED at that statement;
+* any later read of that name is a finding, unless a rebind (assignment,
+  loop target, ``with ... as``) intervenes — ``cache = f(params, cache)``
+  rebinds at the consuming statement and is clean;
+* a donation inside a loop whose body never rebinds the name is flagged
+  at the call: the next iteration dispatches a dead buffer (the
+  ``KVCacheWorkspace.take()/give_back()`` protocol exists to make this
+  rebind explicit).
+
+Attribute state (``self._cache``) is out of scope — the serving engine
+re-binds those from program outputs by contract; the jaxpr harness and
+the contract lockfile guard that path at the compiler level instead.
+"""
+
+import ast
+
+from deepspeed_tpu.tools.lint.core import Finding, dotted_name, rule
+from deepspeed_tpu.tools.lint.rules.tl002_missing_donation import (
+    JIT_NAMES, jit_decorator_kwargs)
+from deepspeed_tpu.tools.lint.rules.tl004_bad_static_args import (
+    _int_tuple, _str_tuple)
+
+
+def _donate_spec(keywords):
+    """(argnums, argnames) of a jit application's donation kwargs."""
+    nums, names = (), ()
+    for kw in keywords or []:
+        if kw.arg == "donate_argnums":
+            nums = _int_tuple(kw.value)
+        elif kw.arg == "donate_argnames":
+            names = _str_tuple(kw.value)
+    return nums, names
+
+
+def _donating_callables(module):
+    """Bare name -> (donated_argnums, donated_argnames)."""
+    out = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and dotted_name(node.value.func) in JIT_NAMES:
+            nums, names = _donate_spec(node.value.keywords)
+            if nums or names:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[tgt.id] = (nums, names)
+    for fn in module.functions:
+        kws = jit_decorator_kwargs(fn.node)
+        if kws:
+            nums, names = _donate_spec(kws)
+            if nums or names:
+                out[fn.name] = (nums, names)
+    return out
+
+
+def _own_nodes(fn_node):
+    """Nodes of ``fn_node`` excluding nested function bodies (each nested
+    def is analyzed as its own function)."""
+    nested = set()
+    for child in ast.walk(fn_node):
+        if child is not fn_node and isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            nested.update(n for n in ast.walk(child) if n is not child)
+    return [n for n in ast.walk(fn_node) if n not in nested]
+
+
+def _parents(fn_node):
+    out = {}
+    for parent in ast.walk(fn_node):
+        for child in ast.iter_child_nodes(parent):
+            out[child] = parent
+    return out
+
+
+def _stmt_of(node, parents, fn_node):
+    while node in parents and not isinstance(node, ast.stmt):
+        node = parents[node]
+    return node if isinstance(node, ast.stmt) else fn_node
+
+
+def _enclosing_loops(node, parents, fn_node):
+    loops = []
+    while node in parents and node is not fn_node:
+        node = parents[node]
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            loops.append(node)
+    return loops
+
+
+@rule("TL007", "variable read after donation")
+def check(module):
+    donating = _donating_callables(module)
+    if not donating:
+        return
+    for fi in module.functions:
+        own = _own_nodes(fi.node)
+        own_set = set(own)
+        parents = _parents(fi.node)
+        stores = [n for n in own if isinstance(n, ast.Name)
+                  and isinstance(n.ctx, ast.Store)]
+        loads = [n for n in own if isinstance(n, ast.Name)
+                 and isinstance(n.ctx, ast.Load)]
+
+        for call in own:
+            if not isinstance(call, ast.Call):
+                continue
+            callee = call.func
+            spec = None
+            cname = None
+            if isinstance(callee, ast.Name) and callee.id in donating:
+                spec, cname = donating[callee.id], callee.id
+            elif isinstance(callee, ast.Call) and \
+                    dotted_name(callee.func) in JIT_NAMES:
+                nums, names = _donate_spec(callee.keywords)
+                if nums or names:
+                    spec = (nums, names)
+                    cname = dotted_name(callee.args[0]) \
+                        if callee.args else "jit"
+            if spec is None:
+                continue
+            nums, names = spec
+            donated = [(a.id, a) for i, a in enumerate(call.args)
+                       if i in nums and isinstance(a, ast.Name)]
+            donated += [(kw.value.id, kw.value) for kw in call.keywords
+                        if kw.arg in names and isinstance(kw.value, ast.Name)]
+            if not donated:
+                continue
+            stmt = _stmt_of(call, parents, fi.node)
+            stmt_end = getattr(stmt, "end_lineno", stmt.lineno)
+            loops = _enclosing_loops(call, parents, fi.node)
+            for name, arg_node in donated:
+                # the consuming statement rebinding the name from the
+                # result (`cache = f(params, cache)`) clears the taint
+                rebound_here = any(
+                    s.id == name and
+                    _stmt_of(s, parents, fi.node) is stmt for s in stores)
+                if not rebound_here:
+                    for read in loads:
+                        if read.id != name or read.lineno <= stmt_end:
+                            continue
+                        cleared = any(
+                            s.id == name and
+                            stmt_end <= s.lineno < read.lineno and
+                            _stmt_of(s, parents, fi.node) is not
+                            _stmt_of(read, parents, fi.node)
+                            for s in stores)
+                        if not cleared:
+                            yield Finding(
+                                "TL007", module.path, read.lineno,
+                                read.col_offset,
+                                f"'{name}' read after being donated to "
+                                f"'{cname}' (line {call.lineno}) — the "
+                                f"buffer is dead; use the returned value "
+                                f"or re-materialize it")
+                            break       # one finding per donated name
+                # donation in a loop: the call itself re-reads the name
+                # next iteration unless the loop body rebinds it
+                for loop in loops:
+                    loop_stores = any(
+                        s.id == name and s in own_set and
+                        loop.lineno <= s.lineno <=
+                        (loop.end_lineno or loop.lineno) for s in stores)
+                    if not loop_stores:
+                        yield Finding(
+                            "TL007", module.path, call.lineno,
+                            call.col_offset,
+                            f"'{name}' donated to '{cname}' inside a loop "
+                            f"that never rebinds it — the next iteration "
+                            f"dispatches a dead buffer; rebind from the "
+                            f"call's result (or take() a fresh one) each "
+                            f"iteration")
+                        break

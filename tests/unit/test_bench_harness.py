@@ -227,3 +227,87 @@ def test_bench_interrupt_emits_partial_record(tmp_path):
     assert record["calibration"]["measured_hbm_gbps"] > 0
     assert record["interrupted_during"] == "north"
     assert record["unit"] == "tokens/s/chip"
+
+
+# --------------------------------------------------------------------- #
+# Per-phase regression thresholds vs the previous BENCH_r* record
+# (warn-and-annotate; ROADMAP item 5 leftover).  Pure host logic.
+# --------------------------------------------------------------------- #
+def test_bench_regression_annotation(tmp_path, monkeypatch):
+    """A phase metric that dropped beyond the threshold vs the newest
+    previous record is annotated in the phase record; small wobbles and
+    non-perf numbers are not."""
+    monkeypatch.setenv("BENCH_OUT_DIR", str(tmp_path))
+    monkeypatch.syspath_prepend(REPO)
+    import bench
+    prev = {"decode": {"decode_tokens_per_sec_chip": 1000.0, "mfu": 0.40,
+                       "e2e_time_s": 2.0, "batch_size": 64,
+                       "sub": {"speedup_vs_sequential": 3.0}}}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(prev))
+
+    phase = {"decode_tokens_per_sec_chip": 700.0, "mfu": 0.39,
+             "e2e_time_s": 2.6, "batch_size": 32,
+             "sub": {"speedup_vs_sequential": 3.1}}
+    bench._annotate_regressions("decode", phase)
+    regs = {r["metric"]: r for r in phase["regressions"]}
+    # 30% throughput drop and 23% slowdown (lower-is-better) annotated...
+    assert "decode_tokens_per_sec_chip" in regs
+    assert regs["decode_tokens_per_sec_chip"]["drop_pct"] == 30.0
+    assert "e2e_time_s" in regs
+    # ...the 2.5% mfu wobble, the improved speedup, and the non-perf
+    # batch_size change are not
+    assert "mfu" not in regs and "batch_size" not in regs
+    assert "sub.speedup_vs_sequential" not in regs
+
+    # within threshold: no annotation key at all
+    ok_phase = {"decode_tokens_per_sec_chip": 950.0, "mfu": 0.41,
+                "e2e_time_s": 2.1, "batch_size": 64}
+    bench._annotate_regressions("decode", ok_phase)
+    assert "regressions" not in ok_phase
+
+    # threshold is tunable; 0 disables
+    tight = {"decode_tokens_per_sec_chip": 950.0}
+    bench._annotate_regressions("decode", tight, threshold=0.01)
+    assert tight["regressions"][0]["drop_pct"] == 5.0
+    off = {"decode_tokens_per_sec_chip": 10.0}
+    bench._annotate_regressions("decode", off, threshold=0)
+    assert "regressions" not in off
+
+    # skipped/errored phases and never-measured phases are untouched
+    skipped = {"skipped": "suite budget exhausted"}
+    bench._annotate_regressions("decode", skipped)
+    assert "regressions" not in skipped
+    fresh = {"tokens_per_sec_chip": 1.0}
+    bench._annotate_regressions("never_measured_phase", fresh)
+    assert "regressions" not in fresh
+
+
+def test_bench_record_normalization(tmp_path, monkeypatch):
+    """The BENCH_r* trail accepts final-format records AND driver
+    wrappers ({n, cmd, rc, tail, parsed}): the record is recovered from
+    `parsed` or from the last stdout line in `tail`; a tail truncated
+    mid-record is skipped rather than wedging the trail."""
+    monkeypatch.setenv("BENCH_OUT_DIR", str(tmp_path))
+    monkeypatch.syspath_prepend(REPO)
+    import bench
+    final = {"decode": {"decode_tokens_per_sec_chip": 5.0}}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(final))
+    wrapper = {"n": 2, "cmd": "python bench.py", "rc": 0, "parsed": None,
+               "tail": "[INFO] noise\n" + json.dumps(
+                   {"decode": {"decode_tokens_per_sec_chip": 7.0}})}
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(wrapper))
+    clipped = {"n": 3, "cmd": "python bench.py", "rc": 124, "parsed": None,
+               "tail": '_per_sec_chip": 8.0}}'}      # cut from the left
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(clipped))
+    parsed = {"n": 4, "cmd": "python bench.py", "rc": 0, "tail": "x",
+              "parsed": {"decode": {"decode_tokens_per_sec_chip": 9.0}}}
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps(parsed))
+
+    trail = bench._round_trail()
+    vals = [r["decode"]["decode_tokens_per_sec_chip"] for r in trail]
+    assert vals == [5.0, 7.0, 9.0]          # clipped r03 skipped
+
+    # regression annotation uses the NEWEST recovered record (r04)
+    phase = {"decode_tokens_per_sec_chip": 6.0}
+    bench._annotate_regressions("decode", phase, trail=trail)
+    assert phase["regressions"][0]["prev"] == 9.0

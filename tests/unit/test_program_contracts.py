@@ -1,0 +1,198 @@
+"""The program-contract lockfile gate (``tools/lint/contract.py`` +
+``PROGRAMS.lock``).
+
+Tier-1 regenerates every contract — primitive multiset, donation-alias
+count, collective counts, abstract signatures — from the REAL hot-path
+programs and the ``parallel/`` sharding plans, and diffs them against the
+committed lockfile: a lost donation, a new host callback, a surprise
+collective, or a drifted signature fails here with a readable per-program
+diff instead of surfacing as an HBM cliff rounds later."""
+
+import json
+import re
+import pathlib
+
+import pytest
+
+from deepspeed_tpu.tools.lint import contract
+
+HERE = pathlib.Path(__file__).resolve().parent
+REPO = HERE.parents[1]
+LOCK = REPO / contract.LOCKFILE_NAME
+
+# hot-path registry names covered by a locked program contract
+_COVERED = {
+    "runtime.train_step": "runtime.train_step",
+    "runtime.apply_update": "runtime.apply_update",
+    "inference.decode": "inference.decode",
+    "inference.prefill_chunk": "inference.prefill_chunk",
+    "serving.decode_step": "serving.decode_step",
+    "serving.admit": "serving.admit",
+    "serving.decode_step_paged": "serving.decode_step_paged",
+    "serving.prefill_chunk_paged": "serving.prefill_chunk_paged",
+    "serving.admit_paged": "serving.admit_paged",
+    "hybrid.rollout_generate": "hybrid.rollout",
+}
+# host-side orchestrators / sub-programs of a locked contract: no single
+# stable jitted program of their own.  A NEW @hot_path lands in neither
+# set and fails test_lockfile_covers_registered_hot_paths until its
+# contract exists (or it is consciously exempted here).
+_ORCHESTRATORS = {
+    "runtime.train_batch",      # host loop around runtime.train_step
+    "runtime.step",             # 3-call path orchestrator
+    "runtime.forward",          # 3-call path orchestrator
+    "runtime.fwd_bwd",          # sub-program of the fused/3-call step
+    "runtime.fwd_bwd_acc",      # gas>1 variant of fwd_bwd
+    "inference.generate",       # host wrapper around inference.decode
+    "hybrid.rollout_cast",      # once-per-optimizer-step view builder
+}
+
+
+def _registered_hot_path_names():
+    """Static sweep: every ``@hot_path("name")`` in the package source."""
+    names = set()
+    pkg = REPO / "deepspeed_tpu"
+    for path in pkg.rglob("*.py"):
+        for m in re.finditer(r'@hot_path\(\s*"([^"]+)"', path.read_text()):
+            names.add(m.group(1))
+    return names
+
+
+@pytest.fixture(scope="module")
+def lock():
+    assert LOCK.exists(), \
+        f"{LOCK} missing — generate with bin/ds_lint --contracts --update"
+    return json.loads(LOCK.read_text())
+
+
+def test_lockfile_covers_registered_hot_paths(lock):
+    """Every @hot_path in the package is either contract-locked or a
+    documented host orchestrator — a new hot path must add its contract
+    (ds_lint --contracts --update) or a conscious exemption above."""
+    registered = _registered_hot_path_names()
+    registered.discard("name")           # the docstring example in hotpath.py
+    unknown = registered - set(_COVERED) - _ORCHESTRATORS
+    assert not unknown, \
+        f"@hot_path entry point(s) with no contract in {LOCK.name}: " \
+        f"{sorted(unknown)}"
+    programs = lock["programs"]
+    missing = {v for v in _COVERED.values()} - set(programs)
+    assert not missing, f"contracts missing from {LOCK.name}: {missing}"
+    # the paged serving programs are explicitly part of the acceptance bar
+    for name in ("serving.decode_step_paged", "serving.prefill_chunk_paged",
+                 "serving.admit_paged"):
+        assert name in programs
+
+
+def test_lockfile_programs_have_sound_contracts(lock):
+    """Locked invariants that must hold regardless of drift: no host
+    callbacks anywhere, and donated programs actually alias."""
+    for name, c in lock["programs"].items():
+        assert c["host_callbacks"] == 0, name
+        if c["donation"]["declared"]:
+            floor = c["donation"]["min_aliased"] or 1
+            assert c["donation"]["aliased"] >= floor, (name, c["donation"])
+
+
+@pytest.mark.parametrize("builder_name", contract.program_names())
+def test_program_contract_matches_lockfile(lock, builder_name):
+    """The gate: regenerate this program's contract and diff it against
+    the committed lockfile — any mismatch fails with the per-program
+    field diff."""
+    name, fresh = contract.build_program_contract(builder_name)
+    locked = lock["programs"].get(name)
+    assert locked is not None, \
+        f"{name} not in {LOCK.name} — run ds_lint --contracts --update"
+    diff = contract.diff_program(name, locked, fresh)
+    assert not diff, "contract break (regenerate-and-diff):\n" + \
+        "\n".join(diff)
+
+
+@pytest.mark.parametrize("plan_name",
+                         [b.__name__ for b in __import__(
+                             "deepspeed_tpu.parallel.plans",
+                             fromlist=["PLAN_BUILDERS"]).PLAN_BUILDERS])
+def test_collective_schedule_matches_lockfile(lock, plan_name):
+    """The static collective-schedule gate: the sharding plan's compiled
+    HLO must carry exactly the locked collective counts (and satisfy the
+    plan's semantic invariants) — MULTICHIP dry-run totals are locked,
+    not re-measured."""
+    name, fresh = contract.build_plan_contract(plan_name)
+    problems = contract.validate_plan_contract(fresh)
+    assert not problems, f"{name}: {problems}"
+    locked = lock["collective_schedules"].get(name)
+    assert locked is not None, \
+        f"{name} not in {LOCK.name} — run ds_lint --contracts --update"
+    diff = contract.diff_program(name, locked, fresh)
+    assert not diff, "collective-schedule break:\n" + "\n".join(diff)
+
+
+# ------------------------------------------------------------------ #
+# The gate actually fails, readably, on synthetic contract breaks
+# ------------------------------------------------------------------ #
+def _synthetic_donating_ep(donate=True):
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.tools.lint.entry_points import EntryPoint
+
+    def update(params, cache):
+        return jax.tree.map(lambda c: c + 1.0, cache)
+
+    fn = jax.jit(update, donate_argnums=(1,)) if donate else jax.jit(update)
+    args = ({"w": jnp.ones((4, 4))}, {"k": jnp.zeros((2, 8))})
+    return EntryPoint("synthetic.update", fn, args, expect_donation=donate)
+
+
+def test_dropped_donation_fails_with_readable_diff():
+    """Acceptance: a synthetic contract break (the exact PR 5 bug class —
+    a donation silently dropped) fails the diff with a per-program,
+    per-field message."""
+    locked = contract.contract_of_entry_point(_synthetic_donating_ep(True))
+    fresh = contract.contract_of_entry_point(_synthetic_donating_ep(False))
+    assert locked["donation"]["aliased"] >= 1
+    assert fresh["donation"]["aliased"] == 0
+    diff = contract.diff_program("synthetic.update", locked, fresh)
+    text = "\n".join(diff)
+    assert diff and diff[0] == "synthetic.update:"
+    assert "donation" in text and "LOST donation" in text
+
+
+def test_surprise_collective_and_primitive_drift_diff():
+    """Tampered lockfile entries produce readable field-level diffs."""
+    locked = {"kind": "collective_schedule", "mesh": {"tp": 2},
+              "collectives": {"all-gather": 35, "all-reduce": 39},
+              "expect": ["all-gather"], "reduction": True}
+    fresh = dict(locked, collectives={"all-gather": 37, "all-reduce": 39,
+                                      "all-to-all": 2})
+    diff = contract.diff_program("parallel.fake", locked, fresh)
+    text = "\n".join(diff)
+    assert "collectives.all-gather: 35 -> 37" in text
+    assert "collectives.all-to-all: 0 -> 2" in text
+
+    # plan semantics (expect / reduction) are part of the schedule contract
+    weakened = dict(locked, expect=[], reduction=False)
+    text = "\n".join(contract.diff_program("parallel.fake", locked, weakened))
+    assert "expect: ['all-gather'] -> []" in text
+    assert "reduction: True -> False" in text
+
+    p_locked = {"kind": "program", "primitives": {"scan": 1, "add": 3},
+                "primitives_sha256": "aaaa", "host_callbacks": 0,
+                "collectives": {}, "donation": {"declared": True,
+                                                "aliased": 2,
+                                                "min_aliased": 0},
+                "in_avals": ["f32[2]"], "out_avals": ["f32[2]"]}
+    p_fresh = dict(p_locked, primitives={"scan": 1, "add": 3,
+                                         "pure_callback": 1},
+                   primitives_sha256="bbbb", host_callbacks=1)
+    diff = contract.diff_program("inference.fake", p_locked, p_fresh)
+    text = "\n".join(diff)
+    assert "primitives.pure_callback: 0 -> 1" in text
+    assert "host_callbacks: 0 -> 1" in text
+
+
+def test_diff_lockfiles_reports_added_and_removed():
+    a = {"programs": {"x": {"kind": "program"}}, "collective_schedules": {}}
+    b = {"programs": {"y": {"kind": "program"}}, "collective_schedules": {}}
+    text = "\n".join(contract.diff_lockfiles(a, b))
+    assert "x: locked but no longer extracted" in text
+    assert "y: not in PROGRAMS.lock" in text

@@ -22,7 +22,8 @@ def lint_fixture(name, rules=None):
 
 
 @pytest.mark.parametrize("rule_id,expected_min", [
-    ("TL001", 7), ("TL002", 3), ("TL003", 4), ("TL004", 2), ("TL005", 2)])
+    ("TL001", 7), ("TL002", 3), ("TL003", 4), ("TL004", 2), ("TL005", 2),
+    ("TL006", 9), ("TL007", 4)])
 def test_rule_positive_fixture(rule_id, expected_min):
     findings, _ = lint_fixture(f"{rule_id.lower()}_positive.py")
     hits = [f for f in findings if f.rule == rule_id]
@@ -31,7 +32,8 @@ def test_rule_positive_fixture(rule_id, expected_min):
 
 
 @pytest.mark.parametrize("rule_id",
-                         ["TL001", "TL002", "TL003", "TL004", "TL005"])
+                         ["TL001", "TL002", "TL003", "TL004", "TL005",
+                          "TL006", "TL007"])
 def test_rule_negative_fixture(rule_id):
     findings, _ = lint_fixture(f"{rule_id.lower()}_negative.py")
     hits = [f for f in findings if f.rule == rule_id]
@@ -65,8 +67,38 @@ def test_cli_exit_codes(capsys):
 def test_cli_list_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rid in ("TL001", "TL002", "TL003", "TL004", "TL005"):
+    for rid in ("TL001", "TL002", "TL003", "TL004", "TL005", "TL006",
+                "TL007"):
         assert rid in out
+
+
+def test_cli_update_requires_contracts(capsys):
+    assert lint_main(["--update"]) == 2
+    capsys.readouterr()
+
+
+# ------------------------------------------------------------------ #
+# Suppression edge cases: decorated functions + multi-rule disables
+# ------------------------------------------------------------------ #
+def test_suppression_on_decorated_functions():
+    """A function-level disable works from the decorator line, from the
+    LAST of stacked decorators, and from the def line under a decorator —
+    all three cover the whole body."""
+    findings, stats = lint_fixture("suppression_edge.py")
+    deco = [f for f in findings if f.rule == "TL001" and f.line <= 23]
+    assert not deco, f"decorated-function suppression leaked: {deco}"
+
+
+def test_multi_rule_disable_on_one_line():
+    """`disable=TL001,TL005 -- reason` suppresses BOTH rules on the line;
+    a single-rule disable on the same pattern still leaks the other."""
+    findings, stats = lint_fixture("suppression_edge.py")
+    assert stats["suppressed"].get("TL001", 0) == 5
+    assert stats["suppressed"].get("TL005", 0) == 1
+    leaked = [f for f in findings if f.rule == "TL005"]
+    assert len(leaked) == 1, leaked
+    src = pathlib.Path(leaked[0].path).read_text().splitlines()
+    assert "disable=TL001 --" in src[leaked[0].line - 1]
 
 
 def test_package_is_lint_clean():
@@ -97,7 +129,7 @@ def test_hot_path_decorator_is_identity():
     "inference_prefill_chunk", "serving_decode_step",
     "serving_admission_prefill", "serving_admit",
     "serving_decode_step_paged", "serving_admission_prefill_paged",
-    "serving_admit_paged"])
+    "serving_admit_paged", "hybrid_rollout"])
 def test_jaxpr_entry_point(builder_name):
     from deepspeed_tpu.parallel.topology import reset_topology
     from deepspeed_tpu.tools.lint import entry_points, jaxpr_check
@@ -141,3 +173,23 @@ def test_jaxpr_check_flags_callbacks():
                     (jnp.ones((4,)),), expect_donation=False)
     result = check_entry_point(ep)
     assert not result.ok and "callback" in result.problems[0]
+
+
+# ------------------------------------------------------------------ #
+# Runtime retrace counter (the dynamic half of TL006)
+# ------------------------------------------------------------------ #
+def test_serving_programs_compile_exactly_once_across_rounds():
+    """Acceptance: the serving decode (and admit / admission-prefill)
+    programs compile EXACTLY ONCE across >= 3 dispatch rounds with
+    drifting host bookkeeping — round-varying request counts, prompt
+    lengths/contents, eos ids, client ids, deadlines.  One extra
+    signature anywhere here is tomorrow's 30 s mid-serve recompile."""
+    from deepspeed_tpu.tools.lint.retrace_check import \
+        measure_serving_retraces
+    result = measure_serving_retraces(rounds=3)
+    assert len(result["per_round"]) == 3
+    for r, counts in enumerate(result["per_round"], 1):
+        for program, n in counts.items():
+            assert n == 1, \
+                f"round {r}: serving {program} program compiled {n} " \
+                f"signatures (retrace drift): {result}"

@@ -1,0 +1,35 @@
+"""Suppression edge cases: function-level disables on DECORATED functions
+(comment on the decorator line, on the last of several decorators, and on
+the def line below decorators) and multi-rule disables on one line."""
+import functools
+
+import jax
+from deepspeed_tpu.tools.lint.hotpath import hot_path
+
+
+@hot_path("fixture.deco1")  # tpu-lint: disable=TL001 -- suppression on the decorator line covers the body
+def on_decorator_line(loss):
+    return loss.item()
+
+
+@functools.partial(jax.jit, donate_argnums=())
+@hot_path("fixture.deco2")  # tpu-lint: disable=TL001 -- suppression on the LAST of stacked decorators
+def on_last_decorator(loss):
+    return loss.item()
+
+
+@hot_path("fixture.deco3")
+def on_def_line_below_decorator(loss):  # tpu-lint: disable=TL001 -- suppression on the def line under a decorator
+    return loss.item()
+
+
+@hot_path("fixture.multi")
+def multi_rule_one_line(loss, config):
+    # one comment, two rules: both must be suppressed on this line
+    return loss.item(), config["lr"]  # tpu-lint: disable=TL001,TL005 -- epoch-boundary drain reads both
+
+
+@hot_path("fixture.multi2")
+def multi_rule_leak(loss, config):
+    # TL001 suppressed, TL005 must still fire on this line
+    return loss.item(), config["lr"]  # tpu-lint: disable=TL001 -- only the host read is intentional

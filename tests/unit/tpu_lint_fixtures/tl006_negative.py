@@ -1,0 +1,60 @@
+"""TL006 negative fixture: stable jit signatures."""
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.tools.lint.hotpath import hot_path
+
+
+def step(params, lr, step_no):
+    return params
+
+
+step_jit = jax.jit(step)
+# dtypes pinned: no weak-type drift against array-typed call sites
+out = step_jit(jnp.ones(4), jnp.asarray(1e-3, jnp.float32),
+               jnp.asarray(7, jnp.int32))
+
+
+def run(x, cfg):
+    return x
+
+
+run_jit = jax.jit(run, static_argnames=("cfg",))
+out2 = run_jit(jnp.ones(2), cfg=(4, "relu"))     # tuple static: value-hashed
+out3 = run_jit(jnp.ones(2), cfg=tuple([1, 2]))   # tuple(): value-hashed
+
+
+def pick(k, x):
+    return x
+
+
+pick_jit = jax.jit(pick, static_argnums=(0,))
+out4 = pick_jit(8, jnp.ones(2))                  # scalar in a STATIC position
+
+# positional scalar at a static_argnames position: resolved via run's
+# signature (cfg is position 1), so it is static, not traced
+out6 = run_jit(jnp.ones(2), 4)
+
+# static_argnames on a callable whose signature is NOT module-local:
+# traced-vs-static is undecidable per position — the scalar check stands down
+ext_jit = jax.jit(jnp.round, static_argnames=("decimals",))
+out7 = ext_jit(jnp.ones(2), 2)
+
+
+def plain(a, b):
+    return a + b
+
+
+# not jitted: Python scalars are fine
+out5 = plain(1, 2)
+
+
+@hot_path("fixture.decode")
+def decode(batch, cache):
+    flags = [True, False]
+    if len(flags) > 1:          # len() of a host-local list: bookkeeping
+        pass
+    done = batch.sum()
+    if done is None:            # no shape probe in the test
+        return cache
+    return batch

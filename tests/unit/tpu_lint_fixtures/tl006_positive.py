@@ -1,0 +1,48 @@
+"""TL006 positive fixture: jit-signature instability (retrace drift)."""
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.tools.lint.hotpath import hot_path
+
+
+def step(params, lr, step_no):
+    return params
+
+
+step_jit = jax.jit(step)
+out = step_jit(jnp.ones(4), 1e-3, 7)            # TL006 x2: scalars traced
+out2 = step_jit(jnp.ones(4), lr=0.5, step_no=jnp.asarray(7))  # TL006: kw scalar
+
+
+def run(x, cfg):
+    return x
+
+
+run_jit = jax.jit(run, static_argnames=("cfg",))
+
+
+def make_cfg():
+    return object()
+
+
+out3 = run_jit(jnp.ones(2), cfg=make_cfg())     # TL006: identity-hashed static
+out4 = run_jit(jnp.ones(2), cfg=lambda: 1)      # TL006: lambda static
+
+
+def pick(k, x):
+    return x
+
+
+pick_jit = jax.jit(pick, static_argnums=(0,))
+out5 = pick_jit(make_cfg(), jnp.ones(2))        # TL006: positional unstable static
+
+
+@hot_path("fixture.decode")
+def decode(batch, cache):
+    if batch.shape[0] > 8:                      # TL006: shape branch on hot path
+        return cache
+    while batch.ndim > 2:                       # TL006: shape branch on hot path
+        batch = batch[0]
+    if len(batch) > 4:                          # TL006: len() of a parameter
+        return cache
+    return batch

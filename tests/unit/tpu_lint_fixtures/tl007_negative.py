@@ -1,0 +1,49 @@
+"""TL007 negative fixture: donation handled correctly."""
+import jax
+import jax.numpy as jnp
+
+
+def _step(params, cache, tok):
+    return tok, cache
+
+
+step = jax.jit(_step, donate_argnums=(1,))
+
+
+def rebind_from_result(params, cache, tok):
+    out, cache = step(params, cache, tok)    # rebinds at the consuming stmt
+    return out, cache.shape                  # reads the NEW buffer
+
+
+def read_before_donation(params, cache, tok):
+    shape = cache.shape                      # read BEFORE the donation
+    out, _ = step(params, cache, tok)
+    return out, shape
+
+
+def loop_rebinds(params, cache, toks):
+    outs = []
+    for tok in toks:
+        out, cache = step(params, cache, tok)   # fresh buffer each iter
+        outs.append(out)
+    return outs
+
+
+def loop_takes_fresh(params, workspace, toks):
+    outs = []
+    for tok in toks:
+        cache = workspace.pop()
+        out, _ = step(params, cache, tok)
+        outs.append(out)
+    return outs
+
+
+def different_name(params, cache, other, tok):
+    out, _ = step(params, cache, tok)
+    return out, other.shape                  # `other` was never donated
+
+
+def undonated_callee(params, cache, tok):
+    plain = jax.jit(_step)
+    out, _ = plain(params, cache, tok)       # no donation declared
+    return out, cache.shape

@@ -1,0 +1,41 @@
+"""TL007 positive fixture: reads after donation."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _step(params, cache, tok):
+    return tok, cache
+
+
+step = jax.jit(_step, donate_argnums=(1,))
+
+
+def read_after_donation(params, cache, tok):
+    out, new_cache = step(params, cache, tok)
+    return out, cache.shape          # TL007: `cache` is dead after the call
+
+
+def double_donation(params, cache, tok):
+    out1, _ = step(params, cache, tok)
+    out2, _ = step(params, cache, tok)   # TL007: second donation of `cache`
+    return out1, out2
+
+
+def donate_in_loop(params, cache, toks):
+    outs = []
+    for tok in toks:
+        out, _ = step(params, cache, tok)   # TL007: loop never rebinds
+        outs.append(out)
+    return outs
+
+
+@functools.partial(jax.jit, donate_argnames=("state",))
+def advance(state, x):
+    return {"v": state["v"] + x}
+
+
+def kwarg_donation(state, x):
+    new = advance(state=state, x=x)
+    return new, state["v"]           # TL007: `state` read after donation

@@ -653,6 +653,155 @@ def serving_overload_bench(model_name="opt-1.3b", *, num_slots=8,
     }
 
 
+def serving_http_bench(model_name="opt-1.3b", *, num_slots=8,
+                       n_requests=24, decode_block=8, prefill_chunk=128):
+    """Network front end micro-phase (``docs/serving.md`` "Network front
+    end"): the SAME mixed workload served twice — direct ``submit()`` /
+    ``drain()`` vs concurrent HTTP clients (2 tenants x 2 priorities,
+    half streaming, half blocking) — recording the transport overhead:
+    req/s and p50/p99 TTFT for both paths, p50/p99 time-between-tokens
+    on the streamed responses, and the decode-executable count proving
+    the HTTP path minted nothing new."""
+    import http.client
+    import json
+    import threading
+    import jax
+    from deepspeed_tpu.models.opt import opt_config
+    from deepspeed_tpu.models.transformer import Transformer
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.serving.frontend import \
+        ServingHTTPFrontend
+
+    cache_len = 384                         # prompts <= 256, new <= 64
+    cfg = opt_config(model_name, max_seq_len=cache_len, dtype="bfloat16",
+                     scan_layers=False)
+    model = Transformer(cfg)
+    eng = InferenceEngine(model, DeepSpeedInferenceConfig(
+        dtype="bfloat16", compile_cache=_cc_block(),
+        serving={"enabled": True, "num_slots": num_slots,
+                 "max_cache_len": cache_len,
+                 "prefill_chunk": prefill_chunk,
+                 "prefill_token_budget": 256,
+                 "decode_block": decode_block,
+                 "priority_lanes": 2}))
+    eng.init_params()
+    rng = np.random.default_rng(0)
+    prompt_lens = rng.choice([64, 96, 128, 192, 256], n_requests)
+    new_lens = rng.choice([16, 32, 64], n_requests)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(p),)).astype(np.int32)
+               for p in prompt_lens]
+
+    def pct(xs, q):
+        return round(float(np.percentile(xs, q)), 4) if len(xs) else None
+
+    # ---- direct path: submit() + drain() on the scheduler thread ----
+    srv = eng.serve()
+    srv.warmup()
+    t0 = time.perf_counter()
+    rids = [srv.submit(p, max_new_tokens=int(n),
+                       client_id=f"tenant-{i % 2}", priority=(i // 2) % 2)
+            for i, (p, n) in enumerate(zip(prompts, new_lens))]
+    srv.drain()
+    t_direct = time.perf_counter() - t0
+    direct_ttfts = sorted(srv._results[r].ttft_s for r in rids
+                          if srv._results[r].ttft_s is not None)
+    # record the decode-executable count, then retire the direct-path
+    # server BEFORE the HTTP server exists — two live servers would
+    # double the phase's KV-workspace footprint for nothing
+    decode_execs = [
+        sum(1 for sig in eng._aot if sig and sig[0] == id(srv._decode_fn))]
+    srv.close()
+
+    # ---- HTTP path: same workload through concurrent clients ----
+    # wire TTFT (streaming clients: submit -> first token ON THE WIRE,
+    # includes transport + queueing) and engine TTFT (blocking clients:
+    # the engine's internal admission->first-token clock) are DIFFERENT
+    # quantities — recorded separately, never mixed in one percentile
+    srv2 = eng.serve()
+    wire_ttfts, engine_ttfts, tbt_gaps, errors = [], [], [], []
+
+    def client(k, port):
+        try:
+            stream = bool(k % 2)
+            t_sub = time.perf_counter()
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=600)
+            conn.request("POST", "/v1/generate", json.dumps(
+                {"input_ids": [int(t) for t in prompts[k]],
+                 "max_new_tokens": int(new_lens[k]),
+                 "client_id": f"tenant-{k % 2}",
+                 "priority": (k // 2) % 2, "stream": stream}))
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise RuntimeError(f"HTTP {resp.status}: {resp.read()!r}")
+            if stream:
+                arrivals = []
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    ev = json.loads(line)
+                    if ev["event"] == "token":
+                        arrivals.append(time.perf_counter())
+                    else:
+                        break
+                if arrivals:
+                    wire_ttfts.append(arrivals[0] - t_sub)
+                    tbt_gaps.extend(np.diff(arrivals).tolist())
+            else:
+                body = json.loads(resp.read())
+                if body.get("ttft_s") is not None:
+                    engine_ttfts.append(body["ttft_s"])
+            conn.close()
+        except Exception as e:              # recorded, fails the phase
+            errors.append(f"client {k}: {type(e).__name__}: {e}")
+
+    t1 = time.perf_counter()
+    with ServingHTTPFrontend(srv2) as fe:
+        threads = [threading.Thread(target=client, args=(k, fe.port))
+                   for k in range(n_requests)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    t_http = time.perf_counter() - t1
+    decode_execs.append(
+        sum(1 for sig in eng._aot if sig and sig[0] == id(srv2._decode_fn)))
+    srv2.close()
+    if errors:
+        raise RuntimeError("serving_http bench clients failed: "
+                           + "; ".join(errors[:5]))
+    wire_ttfts.sort()
+    engine_ttfts.sort()
+    return {
+        "model": model_name,
+        "num_slots": num_slots,
+        "n_requests": n_requests,
+        "tenants": 2,
+        "priorities": 2,
+        "direct_reqs_per_sec": round(n_requests / t_direct, 2),
+        "direct_ttft_p50_s": pct(direct_ttfts, 50),
+        "direct_ttft_p99_s": pct(direct_ttfts, 99),
+        "http_reqs_per_sec": round(n_requests / t_http, 2),
+        # engine TTFT is directly comparable to direct_ttft_* (same
+        # clock); wire TTFT additionally includes the transport
+        "http_engine_ttft_p50_s": pct(engine_ttfts, 50),
+        "http_engine_ttft_p99_s": pct(engine_ttfts, 99),
+        "http_wire_ttft_p50_s": pct(wire_ttfts, 50),
+        "http_wire_ttft_p99_s": pct(wire_ttfts, 99),
+        "http_time_between_tokens_p50_s": pct(tbt_gaps, 50),
+        "http_time_between_tokens_p99_s": pct(tbt_gaps, 99),
+        # < 1.0 = the transport costs throughput; the decode_block
+        # flush cadence bounds per-token latency, not aggregate rate
+        "http_vs_direct_reqs_ratio": round(
+            (n_requests / t_http) / (n_requests / t_direct), 3),
+        # the one-decode-executable invariant through the HTTP path
+        "decode_executables_per_server": decode_execs,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def serving_paged_bench(model_name="opt-1.3b", *, slots_list=(96, 128, 192),
                         page_size=64, pool_fraction=0.75, decode_block=8,
                         prefill_chunk=128, prefix_requests=24,
@@ -1101,6 +1250,15 @@ PHASES = [
      lambda fb: serving_overload_bench("opt-1.3b",
                                        num_slots=4 if fb else 8,
                                        burst_factor=3 if fb else 4)),
+    # network-front-end micro-phase: the same mixed workload via direct
+    # submit() vs concurrent HTTP clients (2 tenants x 2 priorities,
+    # half streaming) — transport overhead on req/s, p50/p99 TTFT and
+    # time-between-tokens; cheap-first, it shares the serving phases'
+    # program shapes
+    ("serving_http", "serving_http",
+     lambda fb: serving_http_bench("opt-1.3b",
+                                   num_slots=4 if fb else 8,
+                                   n_requests=12 if fb else 24)),
     # paged-KV serving at the bs96/128/192 points where the monolithic
     # lanes collapsed (r04), plus the shared-prefix prefill-once story —
     # after the cheap serving phases (it compiles one paged decode

@@ -20,8 +20,9 @@ from deepspeed_tpu.inference.serving.slo import (CircuitBreaker,
 
 __all__ = ["ServingConfig", "ServingEngine", "ServeRequest",
            "RequestStatus", "RequestResult", "QueueFull", "CircuitOpen",
-           "DrainTimeout", "CircuitBreaker", "serve_resilient",
-           "PagePool", "PrefixIndex"]
+           "DrainTimeout", "CircuitBreaker", "TokenStream",
+           "serve_resilient", "ServingHTTPFrontend", "serve_http",
+           "FairnessTracker", "PagePool", "PrefixIndex"]
 
 
 def __getattr__(name):
@@ -32,4 +33,10 @@ def __getattr__(name):
         from deepspeed_tpu.inference.serving.resilient import \
             serve_resilient
         return serve_resilient
+    if name == "TokenStream":
+        from deepspeed_tpu.inference.serving.slo import TokenStream
+        return TokenStream
+    if name in ("ServingHTTPFrontend", "serve_http", "FairnessTracker"):
+        from deepspeed_tpu.inference.serving import frontend
+        return getattr(frontend, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

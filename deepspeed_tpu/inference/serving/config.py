@@ -91,6 +91,27 @@ class ServingConfig(DeepSpeedConfigModel):
     # diagnostics instead of spinning forever on a wedged scheduler;
     # 0 = off (seed behavior)
     drain_timeout_s: float = 0.0
+    # ---- network front end (docs/serving.md "Network front end") ----
+    # admission priority lanes layered on the fcfs/shortest_first queue:
+    # submit(priority=p) with 0 <= p < priority_lanes, 0 = most urgent.
+    # 1 (default) = no lanes, seed admission order
+    priority_lanes: int = 1
+    # starvation bound for the lanes: a queued request's effective
+    # priority improves one lane per this many seconds waited, so the
+    # lowest lane reaches lane 0 after (priority_lanes-1)*aging seconds
+    # and fcfs/shortest_first order takes over; 0 = no aging (strict
+    # lanes — low priority CAN starve under sustained high-priority load)
+    priority_aging_s: float = 30.0
+    # multi-tenant fairness: per-client_id token-rate accounting
+    # (admitted prefill + generated tokens, exponentially decaying
+    # window) feeding admission control — submit() from a client whose
+    # window usage exceeds fairness_tokens_per_s * fairness_window_s
+    # raises QueueFull (HTTP 429) while other clients keep flowing.
+    # 0 = off (seed behavior)
+    fairness_tokens_per_s: float = 0.0
+    # decay time constant (seconds) of the fairness window: usage decays
+    # by 1/e per window, budget = fairness_tokens_per_s * window
+    fairness_window_s: float = 10.0
     # graceful-preemption drain budget (preempt()): keep decoding
     # in-flight slots for up to this many seconds before snapshotting
     # the remainder; 0 = snapshot immediately, no drain

@@ -71,6 +71,7 @@ invariant holds through overload, drain and resume.
 """
 
 import math
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -91,7 +92,8 @@ from deepspeed_tpu.inference.serving.slo import (CircuitBreaker,
                                                  DrainTimeout, QueueFull,
                                                  RequestResult,
                                                  RequestStatus,
-                                                 TERMINAL_STATUSES)
+                                                 TERMINAL_STATUSES,
+                                                 TokenStream)
 from deepspeed_tpu.inference.serving.slots import (init_slot_state,
                                                    make_admit_fn,
                                                    make_decode_block_fn,
@@ -111,7 +113,11 @@ class ServeRequest:
     ``ids + prefix`` and the device decodes only the remaining budget —
     the greedy continuation is bitwise what the uninterrupted run would
     have produced.  ``deadline`` is an absolute ``time.monotonic()``
-    instant (``None`` = no deadline)."""
+    instant (``None`` = no deadline).  ``priority`` is the admission
+    lane (0 = most urgent; only meaningful with
+    ``serving.priority_lanes > 1``); ``streamed`` counts the tokens
+    already published to :meth:`ServingEngine.token_events`
+    subscribers."""
     rid: int
     ids: np.ndarray                  # [P] int32 prompt
     max_new: int
@@ -126,6 +132,9 @@ class ServeRequest:
     prefix: list = field(default_factory=list)
     submit_t: float = 0.0
     first_tok_t: Optional[float] = None
+    priority: int = 0
+    streamed: int = 0
+    resumed: bool = False            # restored from a preempt snapshot
 
     @property
     def fill_ids(self):
@@ -220,6 +229,22 @@ class ServingEngine:
             raise ValueError(f"serving.admission={cfg.admission!r}: "
                              f"one of 'fcfs', 'shortest_first'")
         self.block = max(1, int(cfg.decode_block))
+        # ---- network front end: priority lanes + fairness ----
+        self.priority_lanes = int(cfg.priority_lanes)
+        if self.priority_lanes < 1:
+            raise ValueError(f"serving.priority_lanes="
+                             f"{cfg.priority_lanes}: need >= 1")
+        if float(cfg.priority_aging_s) < 0:
+            raise ValueError(f"serving.priority_aging_s="
+                             f"{cfg.priority_aging_s}: need >= 0")
+        if float(cfg.fairness_tokens_per_s) > 0:
+            from deepspeed_tpu.inference.serving.frontend.fairness import \
+                FairnessTracker
+            self._fairness = FairnessTracker(
+                float(cfg.fairness_tokens_per_s),
+                float(cfg.fairness_window_s))
+        else:
+            self._fairness = None
         # ---- paged KV cache (docs/serving.md "Paged KV cache") ----
         self.paged = bool(cfg.paged)
         if self.paged:
@@ -347,6 +372,25 @@ class ServingEngine:
         self._requests = {}              # rid -> ServeRequest (all known)
         self._results = {}               # rid -> RequestResult (terminal)
         self._pending_reports = {}       # rid -> None, merged into step()
+        # ---- threading model (docs/serving.md "Network front end") ----
+        # ONE lock guards every piece of mutable scheduler state (queue,
+        # requests/results maps, slot mirror, stats, streams): submit()/
+        # cancel()/status()/result()/token_events() are safe from any
+        # thread.  step()/drain()/preempt() additionally enforce a
+        # single SCHEDULER OWNER thread (_check_owner): the host mirror,
+        # the in-flight event deque and the donated-buffer chain assume
+        # exactly one driver, and a second thread racing the mirror
+        # would corrupt slot bookkeeping even under the lock (the lag-
+        # one protocol is stateful across calls).  _cond lets blocked
+        # submit()s (queue_policy="block" from a non-owner thread) wait
+        # for the owner's next step instead of stepping themselves.
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._owner_thread = None        # bound by the first step()
+        self._streams = {}               # rid -> [TokenStream]
+        # set by submit()/restore() so an idle scheduler-owner loop
+        # (frontend/transport.py) can sleep instead of busy-polling
+        self.wake = threading.Event()
         self._breaker = CircuitBreaker(cfg.breaker_threshold,
                                        cfg.breaker_cooldown_s)
         self._closed = False
@@ -362,14 +406,14 @@ class ServingEngine:
                       "sync_secs": 0.0, "shed": 0, "cancelled": 0,
                       "resumed": 0, "prefix_lookups": 0, "prefix_hits": 0,
                       "prefix_tokens_reused": 0, "page_evictions": 0,
-                      "admission_stalls": 0}
+                      "admission_stalls": 0, "fairness_rejected": 0}
         self.occupancy_trace = []                  # (iteration, n_active)
 
     # ------------------------------------------------------------------ #
     # Public API
     # ------------------------------------------------------------------ #
     def submit(self, input_ids, max_new_tokens=32, eos_token_id=-1,
-               deadline_s=None, client_id=None):
+               deadline_s=None, client_id=None, priority=0):
         """Enqueue one prompt; returns the request id.  The request must
         fit a slot lane: ``ceil(P/chunk)*chunk <= max_cache_len`` (chunked
         prefill writes the padded tail) and ``P + max_new_tokens <=
@@ -382,12 +426,32 @@ class ServingEngine:
         status ``SHED_DEADLINE``.  ``client_id`` is an opaque correlation
         value round-tripped through results and preemption snapshots
         (snapshots store it as JSON: non-serializable values are
-        stringified, tuples come back as lists).
+        stringified, tuples come back as lists); with fairness enabled
+        (``serving.fairness_tokens_per_s > 0``) it is also the accounting
+        key — an over-budget client's submit raises
+        :class:`~.slo.QueueFull` (HTTP 429) until its window decays.
+        ``priority`` is the admission lane, ``0 <= priority <
+        serving.priority_lanes`` with 0 the most urgent; queued requests
+        age one lane per ``serving.priority_aging_s`` seconds so low
+        priority cannot starve.
+
+        Thread-safe: any thread may submit (the engine lock serializes it
+        against the scheduler owner's ``step()``).
 
         Raises :class:`~.slo.QueueFull` when the bounded queue is at
         ``max_queue_depth`` under the ``reject`` policy (``block`` runs
-        scheduler iterations inline until a spot frees), and
+        scheduler iterations inline when called from the scheduler-owner
+        thread, and waits for the owner to free a spot otherwise), and
         :class:`~.slo.CircuitOpen` while the dispatch breaker is open."""
+        with self._lock:
+            rid = self._submit_locked(input_ids, max_new_tokens,
+                                      eos_token_id, deadline_s, client_id,
+                                      priority)
+        self.wake.set()                  # rouse an idle scheduler thread
+        return rid
+
+    def _submit_locked(self, input_ids, max_new_tokens, eos_token_id,
+                       deadline_s, client_id, priority):
         if self._closed:
             raise RuntimeError(
                 "submit() on a closed ServingEngine — close() retired it; "
@@ -399,6 +463,12 @@ class ServingEngine:
             raise ValueError("empty prompt")
         if max_new < 1:
             raise ValueError(f"max_new_tokens={max_new}: need >= 1")
+        priority = int(priority)
+        if not 0 <= priority < self.priority_lanes:
+            raise ValueError(
+                f"priority={priority}: need 0 <= priority < "
+                f"serving.priority_lanes={self.priority_lanes} "
+                f"(0 = most urgent)")
         padded = -(-P // self.chunk) * self.chunk
         need = max(P + max_new, padded)
         if need > self.cache_len:
@@ -419,6 +489,14 @@ class ServingEngine:
                 f"(num_pages={self.num_pages} incl. trash) — raise "
                 f"serving.num_pages or split the request")
         self._breaker.check_submit()         # reject-with-reason when open
+        if self._fairness is not None and not self._fairness.allow(client_id):
+            self.stats["fairness_rejected"] += 1
+            raise QueueFull(
+                f"client {client_id!r} is over its fairness budget "
+                f"({self._fairness.usage(client_id):.0f} window tokens "
+                f">= {self._fairness.budget:.0f}) — retry after the "
+                f"window decays (HTTP 429; docs/serving.md 'Network "
+                f"front end')")
         self._apply_backpressure()
         if deadline_s is None and self.config.default_deadline_s > 0:
             deadline_s = self.config.default_deadline_s
@@ -426,7 +504,8 @@ class ServingEngine:
             else time.monotonic() + float(deadline_s)
         req = ServeRequest(self._next_rid, ids, max_new, int(eos_token_id),
                            submitted_it=self._it, deadline=deadline,
-                           client_id=client_id, submit_t=time.monotonic())
+                           client_id=client_id, submit_t=time.monotonic(),
+                           priority=priority)
         self._next_rid += 1
         self._queue.append(req)
         self._requests[req.rid] = req
@@ -440,57 +519,157 @@ class ServingEngine:
             raise QueueFull(
                 f"serving queue at max_queue_depth={depth} "
                 f"(policy=reject) — retry later or raise the bound")
-        # block: run the scheduler inline until a spot frees.  Progress is
-        # guaranteed while anything can retire or admit; an open breaker
-        # with an idle scheduler cannot make progress — reject then.
+        if self._owner_thread is not None \
+                and self._owner_thread is not threading.current_thread():
+            # block, from a NON-owner thread (an HTTP handler): wait for
+            # the owner's step() to free a spot — stepping here would
+            # race the host mirror.  _cond releases the engine lock while
+            # waiting, so the owner keeps scheduling.
+            while len(self._queue) >= depth:
+                if self._closed:
+                    raise RuntimeError(
+                        "submit() on a closed ServingEngine — close() "
+                        "retired it while this submit was blocked")
+                if self._no_block_progress():
+                    raise QueueFull(
+                        f"serving queue at max_queue_depth={depth} and "
+                        f"the blocked submit cannot make progress: "
+                        f"{self._breaker.last_error or 'circuit open'}")
+                self._cond.wait(timeout=0.05)
+            return
+        # block, from the owner (or a not-yet-owned engine): run the
+        # scheduler inline until a spot frees.  Progress is guaranteed
+        # while anything can retire or admit; an open breaker with an
+        # idle scheduler cannot make progress — reject then.
         while len(self._queue) >= depth:
-            if self._breaker.open and not self._breaker.allow_dispatch() \
-                    and not (self._events or self._mirror_active.any()
-                             or self._pending is not None):
+            if self._no_block_progress():
                 raise QueueFull(
                     f"serving queue at max_queue_depth={depth} and the "
                     f"blocked submit cannot make progress: "
                     f"{self._breaker.last_error or 'circuit open'}")
             self.step()
 
+    def _no_block_progress(self):
+        return self._breaker.open and not self._breaker.allow_dispatch() \
+            and not (self._events or self._mirror_active.any()
+                     or self._pending is not None)
+
+    def _known(self, rid, what):
+        """The :class:`ServeRequest` for ``rid``, or a CLEAR ``KeyError``
+        for ids this server never issued — a typo'd/stale rid must fail
+        loudly, not look like a still-running request."""
+        req = self._requests.get(rid)
+        if req is None:
+            raise KeyError(
+                f"unknown request id {rid!r} — {what} on a request this "
+                f"server never issued (submit() returned the valid ids)")
+        return req
+
     def cancel(self, rid):
         """Client cancellation.  A queued request is retired immediately
         (never occupies a slot); an in-slot request is retired at this
         scheduling point — its slot returns to the free list and any
         tokens still in flight for it are discarded.  Terminal status
-        ``CANCELLED``.  Returns ``False`` for unknown or already-terminal
-        requests."""
-        req = self._requests.get(rid)
-        if req is None or req.status in TERMINAL_STATUSES \
-                or req.status == RequestStatus.PREEMPTED:
-            return False
-        self.stats["cancelled"] += 1
-        if req in self._queue:
-            self._queue.remove(req)
+        ``CANCELLED``.  Returns ``False`` for already-terminal (or
+        preempted) requests; raises ``KeyError`` for ids this server
+        never issued.  Thread-safe."""
+        with self._lock:
+            req = self._known(rid, "cancel()")
+            if req.status in TERMINAL_STATUSES \
+                    or req.status == RequestStatus.PREEMPTED:
+                return False
+            self.stats["cancelled"] += 1
+            if req in self._queue:
+                self._queue.remove(req)
+                self._record_terminal(req, RequestStatus.CANCELLED,
+                                      "cancelled while queued")
+                self._cond.notify_all()      # a queue spot freed
+                return True
+            if self._pending is not None and self._pending.req is req:
+                self._lane_pool.give_back(self._pending.lane)
+                self._free.append(int(self._pending.slot))
+                self._release_slot_pages(self._pending.slot)
+                self._pending = None
+                self._record_terminal(req, RequestStatus.CANCELLED,
+                                      "cancelled during admission prefill")
+                return True
             self._record_terminal(req, RequestStatus.CANCELLED,
-                                  "cancelled while queued")
+                                  f"cancelled in slot {req.slot}")
+            self._retire_slot_host_side(req)
             return True
-        if self._pending is not None and self._pending.req is req:
-            self._lane_pool.give_back(self._pending.lane)
-            self._free.append(int(self._pending.slot))
-            self._release_slot_pages(self._pending.slot)
-            self._pending = None
-            self._record_terminal(req, RequestStatus.CANCELLED,
-                                  "cancelled during admission prefill")
-            return True
-        self._record_terminal(req, RequestStatus.CANCELLED,
-                              f"cancelled in slot {req.slot}")
-        self._retire_slot_host_side(req)
-        return True
 
     def status(self, rid):
-        """The request's :class:`~.slo.RequestStatus` string."""
-        return self._requests[rid].status
+        """The request's :class:`~.slo.RequestStatus` string; ``KeyError``
+        for ids this server never issued.  Thread-safe."""
+        with self._lock:
+            return self._known(rid, "status()").status
 
     def result(self, rid):
         """The terminal :class:`~.slo.RequestResult`, or ``None`` while
-        the request is still queued/running."""
-        return self._results.get(rid)
+        the request is still queued/running; ``KeyError`` for ids this
+        server never issued.  Thread-safe."""
+        with self._lock:
+            self._known(rid, "result()")
+            return self._results.get(rid)
+
+    def token_events(self, rid, on_event=None):
+        """Subscribe to the request's per-token event stream — a
+        :class:`~.slo.TokenStream` fed from the host-mirror drain point
+        (one event behind the device, flushed as each ``decode_block``'s
+        tokens are processed), so TTFT and time-between-tokens are
+        observable per request without synchronizing the dispatch path.
+
+        Subscribing replays everything already generated (and, for a
+        terminal request, the typed ``end`` event), so the stream is
+        lossless no matter when the consumer attaches; resumed requests
+        replay their prior-incarnation tokens first.  ``on_event``
+        bridges each push synchronously into another world (the HTTP
+        transport passes ``loop.call_soon_threadsafe``); it must never
+        block.  ``KeyError`` for ids this server never issued.
+        Thread-safe."""
+        with self._lock:
+            req = self._known(rid, "token_events()")
+            stream = TokenStream(rid, on_event=on_event)
+            for i, t in enumerate(req.tokens):
+                stream.push({"event": "token", "rid": rid,
+                             "index": i, "token": int(t)})
+            if req.status in TERMINAL_STATUSES \
+                    or req.status == RequestStatus.PREEMPTED:
+                res = self._results.get(rid)
+                stream.push({"event": "end", "rid": rid,
+                             "status": req.status,
+                             "detail": res.detail if res is not None
+                             else ""})
+            else:
+                self._streams.setdefault(rid, []).append(stream)
+            return stream
+
+    def _publish_progress(self, req):
+        """Push the request's not-yet-streamed tokens to every subscriber
+        (called under the lock at the host-mirror drain points — the
+        per-token stream is exactly the retirement bookkeeping's view,
+        one event behind the device)."""
+        n = len(req.tokens)
+        streams = self._streams.get(req.rid)
+        if streams:
+            for i in range(req.streamed, n):
+                ev = {"event": "token", "rid": req.rid, "index": i,
+                      "token": int(req.tokens[i])}
+                for s in streams:
+                    s.push(ev)
+        req.streamed = n
+
+    def _publish_end(self, req, status, detail=""):
+        """The typed terminal event — exactly once, last; subscribers
+        are dropped (late ``token_events()`` calls replay from the
+        request record instead)."""
+        self._publish_progress(req)
+        streams = self._streams.pop(req.rid, None)
+        if streams:
+            ev = {"event": "end", "rid": req.rid, "status": status,
+                  "detail": detail}
+            for s in streams:
+                s.push(ev)
 
     def _release_slot_pages(self, slot):
         """Paged mode: return a retired slot's pages to the pool (shared
@@ -546,6 +725,9 @@ class ServingEngine:
             client_id=req.client_id, submitted_it=req.submitted_it,
             finished_it=self._it, ttft_s=ttft)
         self._pending_reports[req.rid] = None
+        # result is recorded BEFORE the end event: a subscriber woken by
+        # "end" can immediately read result(rid)
+        self._publish_end(req, status, detail)
 
     def _shed_expired(self):
         """Deadline enforcement at the scheduling point: expired QUEUED
@@ -583,6 +765,64 @@ class ServingEngine:
                                   f"after {len(req.tokens)} token(s)")
             self._retire_slot_host_side(req)
 
+    def _check_owner(self, what):
+        """Bind the SCHEDULER OWNER to the first thread that drives the
+        engine and refuse every other thread afterwards: the host mirror,
+        the in-flight event deque and the donated-buffer chain are
+        stateful ACROSS calls (the lag-one protocol), so two drivers
+        corrupt slot bookkeeping even with every individual call locked.
+        submit()/cancel()/status()/result()/token_events() stay callable
+        from any thread — only the driving methods are owner-bound.
+
+        A dedicated scheduler thread (frontend/transport.py) calls
+        :meth:`bind_owner` BEFORE any request can arrive: without the
+        eager claim, a blocked ``queue_policy="block"`` submit racing
+        the owner's first ``step()`` could bind ITSELF as owner and
+        wedge the real scheduler thread forever."""
+        me = threading.current_thread()
+        with self._lock:
+            if self._owner_thread is None:
+                self._owner_thread = me
+                return
+            if self._owner_thread is not me:
+                raise RuntimeError(
+                    f"{what} from thread {me.name!r} but this "
+                    f"ServingEngine's scheduler owner is "
+                    f"{self._owner_thread.name!r} — exactly one thread "
+                    f"may drive step()/drain()/preempt() (the host "
+                    f"mirror is stateful across calls); other threads "
+                    f"use submit()/result()/cancel()/token_events() "
+                    f"(docs/serving.md 'Network front end')")
+
+    def bind_owner(self):
+        """Eagerly claim the scheduler-owner role for the CURRENT thread
+        (idempotent for the owner; raises for any other thread once
+        bound).  A dedicated scheduler thread calls this before work can
+        arrive, closing the race where a blocked ``block``-policy submit
+        binds itself as owner ahead of the real driver's first
+        ``step()``."""
+        self._check_owner("bind_owner()")
+
+    def release_owner(self):
+        """Release the scheduler-owner binding — called by an EXITING
+        owner thread (frontend/transport.py's scheduler loop on its way
+        out) so a successor driver can claim the engine afterwards.
+        Sequential handoff is safe: the mirror's cross-call state lives
+        in the engine, the binding only exists to forbid CONCURRENT
+        drivers.  No-op when unowned; raises from any non-owner thread
+        (stealing the role while the owner lives is the bug the binding
+        prevents)."""
+        me = threading.current_thread()
+        with self._lock:
+            if self._owner_thread is None:
+                return
+            if self._owner_thread is not me:
+                raise RuntimeError(
+                    f"release_owner() from thread {me.name!r} but the "
+                    f"scheduler owner is {self._owner_thread.name!r} — "
+                    f"only the owner thread may release its binding")
+            self._owner_thread = None
+
     def step(self):
         """One scheduler iteration: deadline shedding, admission prefill
         under the token budget, one decode-block dispatch, then process
@@ -590,7 +830,16 @@ class ServingEngine:
         ``{rid: output}`` for every request that reached a terminal
         status this iteration — ``np.ndarray`` for ``COMPLETED``,
         ``None`` for shed/cancelled/aborted (typed detail via
-        :meth:`result`)."""
+        :meth:`result`).
+
+        Owner-bound: the first thread to call a driving method
+        (``step``/``drain``/``preempt``) becomes the scheduler owner and
+        every other thread's call raises — see ``_check_owner``."""
+        self._check_owner("step()")
+        with self._lock:
+            return self._step_locked()
+
+    def _step_locked(self):
         if self._closed:
             raise RuntimeError("step() on a closed ServingEngine")
         t0 = time.perf_counter()
@@ -631,6 +880,9 @@ class ServingEngine:
         if self._pending_reports:
             finished.update(self._pending_reports)
             self._pending_reports.clear()
+        # retirements/admissions may have freed queue spots: rouse
+        # blocked non-owner submit()s (queue_policy="block")
+        self._cond.notify_all()
         return finished
 
     def drain(self, timeout_s=None):
@@ -642,6 +894,7 @@ class ServingEngine:
         past it :class:`~.slo.DrainTimeout` is raised with per-slot
         diagnostics (slot id, request id, last dispatch age) instead of
         spinning forever on a wedged scheduler."""
+        self._check_owner("drain()")
         if timeout_s is None:
             timeout = self.config.drain_timeout_s or None
         else:
@@ -706,6 +959,10 @@ class ServingEngine:
         ``step()`` afterwards raise.  Idempotent: every call returns the
         same sorted list of the request ids that were undrained at the
         first close."""
+        with self._lock:
+            return self._close_locked()
+
+    def _close_locked(self):
         if self._closed:
             return list(self._close_report)
         finished = {}
@@ -740,6 +997,10 @@ class ServingEngine:
             self._pool_ws.release()
         self._closed = True
         self._close_report = undrained
+        # blocked submit()s must observe _closed and raise, idle
+        # scheduler loops must notice the shutdown
+        self._cond.notify_all()
+        self.wake.set()
         if undrained:
             logger.warning(f"serving close(): {len(undrained)} undrained "
                            f"request(s) {undrained} aborted")
@@ -896,11 +1157,39 @@ class ServingEngine:
     # Admission: queue -> prefill chunks -> fused admit dispatch
     # ------------------------------------------------------------------ #
     def _pop_request(self):
+        if self.priority_lanes > 1:
+            return self._pop_request_priority()
         if self.config.admission == "shortest_first":
             req = min(self._queue, key=lambda r: (len(r.ids), r.rid))
             self._queue.remove(req)
             return req
         return self._queue.popleft()
+
+    def _pop_request_priority(self):
+        """Priority lanes over the base admission order: pop the lowest
+        EFFECTIVE lane, breaking ties with the configured policy (queue
+        position for fcfs, prompt length for shortest_first).  Effective
+        lane = ``priority - floor(waited / priority_aging_s)`` clamped at
+        0, so a lane-``k`` request reaches lane 0 after at most
+        ``k * priority_aging_s`` seconds queued — the aging bound that
+        keeps sustained high-priority load from starving low priority
+        (``priority_aging_s = 0`` disables aging: strict lanes)."""
+        now = time.monotonic()
+        aging = float(self.config.priority_aging_s)
+
+        def lane(r):
+            if aging <= 0:
+                return r.priority
+            return max(0, r.priority - int((now - r.submit_t) / aging))
+
+        if self.config.admission == "shortest_first":
+            req = min(self._queue,
+                      key=lambda r: (lane(r), len(r.ids), r.rid))
+        else:
+            req = min(enumerate(self._queue),
+                      key=lambda ir: (lane(ir[1]), ir[0]))[1]
+        self._queue.remove(req)
+        return req
 
     def _admit(self):
         limit = self.config.prefill_token_budget or math.inf
@@ -919,6 +1208,17 @@ class ServingEngine:
                     self._queue.appendleft(req)
                     self.stats["admission_stalls"] += 1
                     return
+                if self._fairness is not None and not req.resumed:
+                    # charge admitted prefill work once, when admission
+                    # actually starts (a paged stall above retries the
+                    # same request without double-charging).  Resumed
+                    # requests charge NOTHING here: their prompt and
+                    # generated-so-far tokens were billed in the prior
+                    # incarnation and ride the snapshot balance — the
+                    # re-prefill is the server's preemption cost, not
+                    # the client's
+                    self._fairness.charge(req.client_id,
+                                          len(req.fill_ids))
                 self._pending = pend
             done = self._run_prefill_chunk(self._pending)
             spent += self.chunk
@@ -1205,6 +1505,10 @@ class ServingEngine:
         if req.first_tok_t is None:
             req.first_tok_t = time.monotonic()
         req.tokens = list(req.prefix) + [first]
+        if self._fairness is not None:
+            # the sampled first token; prefill tokens (incl. any resumed
+            # prefix) were charged when admission started
+            self._fairness.charge(req.client_id, 1)
         # mirror the admit program's activation rule (the device saw the
         # REMAINING budget max_new - len(prefix))
         dev_new = req.max_new - len(req.prefix)
@@ -1215,6 +1519,7 @@ class ServingEngine:
             finished[req.rid] = self._finalize(req)
         else:
             self._mirror_active[slot] = True
+            self._publish_progress(req)
 
     def _process_decode(self, ev, finished):
         t0 = time.perf_counter()
@@ -1229,6 +1534,8 @@ class ServingEngine:
                 tok = int(row[s])
                 req.tokens.append(tok)
                 self.stats["decode_tokens"] += 1
+                if self._fairness is not None:
+                    self._fairness.charge(req.client_id, 1)
                 if (req.eos >= 0 and tok == req.eos) \
                         or len(req.tokens) >= req.max_new:
                     self._mirror_active[s] = False
@@ -1236,6 +1543,11 @@ class ServingEngine:
                     self._free.append(int(s))
                     self._release_slot_pages(s)
                     finished[req.rid] = self._finalize(req)
+                else:
+                    # per-token streaming flush: the host-mirror drain
+                    # point IS the stream's tick — one event behind the
+                    # device, TTFT/time-between-tokens observable here
+                    self._publish_progress(req)
         self.occupancy_trace.append(
             (self._it, int(self._mirror_active.sum())))
 
@@ -1258,6 +1570,7 @@ class ServingEngine:
             rid=req.rid, status=RequestStatus.COMPLETED, output=out,
             client_id=req.client_id, submitted_it=req.submitted_it,
             finished_it=self._it, ttft_s=ttft)
+        self._publish_end(req, RequestStatus.COMPLETED)
         return out
 
     # ------------------------------------------------------------------ #
@@ -1289,6 +1602,12 @@ class ServingEngine:
         during the drain.  A restarted server picks the snapshot up with
         :meth:`restore`; greedy resumed outputs are bitwise-identical to
         an uninterrupted run."""
+        self._check_owner("preempt()")
+        with self._lock:
+            return self._preempt_locked(checkpoint_dir, drain_budget_s,
+                                        tag)
+
+    def _preempt_locked(self, checkpoint_dir, drain_budget_s, tag):
         if self._closed:
             raise RuntimeError("preempt() on a closed ServingEngine")
         budget = self.config.drain_budget_s if drain_budget_s is None \
@@ -1324,6 +1643,13 @@ class ServingEngine:
         tag = self.snapshot(checkpoint_dir, tag=tag)
         for req in undrained:
             req.status = RequestStatus.PREEMPTED
+            # active HTTP/token streams end with the TYPED event — the
+            # client knows its request resumes on a restarted server
+            # (reconnect and re-subscribe) instead of seeing a dead
+            # socket with no verdict
+            self._publish_end(req, RequestStatus.PREEMPTED,
+                              f"preempted — snapshotted for resume "
+                              f"(tag {tag!r})")
         snapped = [r.rid for r in undrained]
         # retire the engine without ABORTED accounting: the snapshotted
         # requests are not lost, they resume elsewhere
@@ -1349,6 +1675,8 @@ class ServingEngine:
             self._pool_ws.release()
         self._closed = True
         self._close_report = sorted(snapped)
+        self._cond.notify_all()
+        self.wake.set()
         self.stats["drain_secs"] = \
             self.stats.get("drain_secs", 0.0) + drain_secs
         self.stats["preempt_snapshotted"] = len(snapped)
@@ -1397,6 +1725,7 @@ class ServingEngine:
                 "deadline_remaining_s":
                     None if r.deadline is None else r.deadline - now,
                 "submitted_it": int(r.submitted_it),
+                "priority": int(r.priority),
             }
             if self.paged and r.slot is not None \
                     and int(r.slot) in self._slot_pages:
@@ -1415,6 +1744,11 @@ class ServingEngine:
                 jax.random.key_data(self._rng)).ravel().tolist(),
             "requests": reqs,
         }
+        if self._fairness is not None:
+            # quota balances survive preemption: a restarted server keeps
+            # enforcing the same per-client budgets (conservative — decay
+            # during the downtime is not credited; frontend/fairness.py)
+            state["fairness"] = self._fairness.state_dict()
         return save_snapshot(
             checkpoint_dir, tag, state,
             checksum=getattr(fcfg, "checksum", None) or "sha256")
@@ -1433,7 +1767,15 @@ class ServingEngine:
         tag, state = load_newest_snapshot(checkpoint_dir)
         if state is None:
             return []
+        with self._lock:
+            rids = self._restore_locked(tag, state)
+        self.wake.set()                  # rouse an idle scheduler thread
+        return rids
+
+    def _restore_locked(self, tag, state):
         self._snap_seq = max(self._snap_seq, int(state.get("seq", 0)))
+        if self._fairness is not None and state.get("fairness"):
+            self._fairness.load_state(state["fairness"])
         if state.get("rng"):
             self._rng = jax.random.wrap_key_data(
                 jnp.asarray(state["rng"], jnp.uint32))
@@ -1458,7 +1800,12 @@ class ServingEngine:
             req = ServeRequest(
                 int(r["rid"]), ids, max_new, eos, submitted_it=self._it,
                 deadline=deadline, client_id=r.get("client_id"),
-                prefix=prefix, submit_t=now)
+                prefix=prefix, submit_t=now, resumed=True,
+                # clamp to THIS server's lane count (the snapshot may
+                # come from a config with more lanes); aging restarts
+                # from restore time — conservative, never a starvation
+                priority=min(int(r.get("priority", 0)),
+                             self.priority_lanes - 1))
             # every restored request must pass submit()'s capacity check
             # against THIS server's lane config (the snapshot may come
             # from a server with a larger max_cache_len / smaller chunk
@@ -1563,6 +1910,9 @@ class ServingEngine:
             ("Serving/breaker_open",
              1.0 if self._breaker.open else 0.0, self._it),
         ] + ([
+            ("Serving/fairness_rejected",
+             self.stats["fairness_rejected"], self._it),
+        ] if self._fairness is not None else []) + ([
             ("Serving/page_pool_util", self.page_pool_utilization,
              self._it),
             ("Serving/prefix_hit_rate", self.prefix_hit_rate, self._it),

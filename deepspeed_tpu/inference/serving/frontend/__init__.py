@@ -1,0 +1,23 @@
+"""Network front end for the serving engine (``docs/serving.md``
+"Network front end"): the asyncio HTTP transport with per-token
+streaming (``transport.py``) and multi-tenant fairness accounting
+(``fairness.py``).  Priority lanes live in the engine's admission queue
+(``serving.priority_lanes``); this package is pure host orchestration —
+it adds no jitted programs, so the one-decode-executable-per-server
+invariant is untouched.
+
+``transport`` is imported lazily: ``fairness`` must stay importable from
+the engine's ``__init__`` without dragging in asyncio machinery.
+"""
+
+from deepspeed_tpu.inference.serving.frontend.fairness import \
+    FairnessTracker
+
+__all__ = ["FairnessTracker", "ServingHTTPFrontend", "serve_http"]
+
+
+def __getattr__(name):
+    if name in ("ServingHTTPFrontend", "serve_http"):
+        from deepspeed_tpu.inference.serving.frontend import transport
+        return getattr(transport, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,113 @@
+"""Multi-tenant fairness — per-``client_id`` token-rate accounting on a
+decaying window (``docs/serving.md`` "Network front end").
+
+A public endpoint in front of a fixed-capacity slot engine needs an
+answer to the one abusive tenant problem: without accounting, a client
+that submits 4x everyone else's load owns 4x the slots, and every other
+client's TTFT degrades in proportion.  :class:`FairnessTracker` charges
+each client for the work it actually consumes — admitted prefill tokens
+at admission and generated tokens as the host mirror processes them —
+into an exponentially decaying accumulator (time constant
+``window_s``), and the serving engine's admission control refuses
+``submit()`` (``QueueFull`` → HTTP 429) from any client whose window
+usage exceeds ``tokens_per_s * window_s``.  Over-quota clients recover
+as their usage decays; under-quota clients keep flowing the whole time
+(``tests/unit/test_serving_frontend.py`` proves the light client's p99
+TTFT stays bounded while only the heavy client sheds).
+
+Host bookkeeping only — all calls run under the serving engine's lock,
+and the state round-trips preemption snapshots so a restarted server
+keeps enforcing the same quotas.
+"""
+
+import math
+import time
+
+
+class FairnessTracker:
+    """Decaying-window token accounting per client.
+
+    ``usage(c)`` decays by ``1/e`` per ``window_s`` seconds, so the
+    sustainable steady-state rate is exactly ``tokens_per_s`` and a
+    silent client's balance is forgotten after a few windows.  Clients
+    are keyed by ``str(client_id)`` (client ids are opaque and may be
+    unhashable).  ``clock`` is injectable for deterministic tests."""
+
+    def __init__(self, tokens_per_s, window_s=10.0, clock=time.monotonic):
+        self.tokens_per_s = float(tokens_per_s)
+        self.window_s = float(window_s)
+        if self.tokens_per_s <= 0:
+            raise ValueError(f"fairness_tokens_per_s={tokens_per_s}: "
+                             f"need > 0 (0 disables fairness upstream)")
+        if self.window_s <= 0:
+            raise ValueError(f"fairness_window_s={window_s}: need > 0")
+        self._clock = clock
+        self._usage = {}                 # key -> [window_tokens, last_t]
+
+    @property
+    def budget(self):
+        """The window budget: usage past it denies admission."""
+        return self.tokens_per_s * self.window_s
+
+    @staticmethod
+    def key(client_id):
+        return str(client_id)
+
+    def _decayed(self, entry, now):
+        tokens, t = entry
+        if now > t:
+            tokens *= math.exp(-(now - t) / self.window_s)
+        return tokens
+
+    def usage(self, client_id):
+        """The client's current window-token balance (decayed to now)."""
+        entry = self._usage.get(self.key(client_id))
+        return self._decayed(entry, self._clock()) if entry else 0.0
+
+    def allow(self, client_id):
+        """Admission verdict: ``False`` while the client is over budget
+        (the caller rejects with ``QueueFull`` — HTTP 429)."""
+        return self.usage(client_id) < self.budget
+
+    def charge(self, client_id, tokens):
+        """Account ``tokens`` of consumed work (admitted prefill or
+        generated tokens) to the client."""
+        key = self.key(client_id)
+        now = self._clock()
+        entry = self._usage.get(key)
+        balance = self._decayed(entry, now) if entry else 0.0
+        self._usage[key] = [balance + float(tokens), now]
+
+    def window_usage(self):
+        """``{client_key: window_tokens}`` decayed to now (metrics and
+        snapshots); near-zero balances are dropped so an old tenant set
+        cannot grow the map forever."""
+        now = self._clock()
+        out = {}
+        for key, entry in list(self._usage.items()):
+            balance = self._decayed(entry, now)
+            if balance < 1e-6:
+                del self._usage[key]
+                continue
+            out[key] = balance
+        return out
+
+    def state_dict(self):
+        """Snapshot payload: balances decayed to NOW.  Restore treats
+        them as balances at restore time — decay during the downtime is
+        deliberately not credited (conservative: a preempt/restore cycle
+        never launders an over-quota client back under budget)."""
+        return {"tokens_per_s": self.tokens_per_s,
+                "window_s": self.window_s,
+                "usage": self.window_usage()}
+
+    def load_state(self, state):
+        """Adopt a snapshot's balances (this tracker's own rate/window
+        config wins — quotas are a server property, not snapshot
+        payload)."""
+        now = self._clock()
+        for key, tokens in (state.get("usage") or {}).items():
+            self._usage[str(key)] = [float(tokens), now]
+
+
+__all__ = ["FairnessTracker"]

@@ -10,11 +10,14 @@ with doomed dispatches.  Everything here is host bookkeeping — SLO state
 never touches a compiled program (the one-decode-executable invariant).
 """
 
+import queue
 import time
 from dataclasses import dataclass
 from typing import Any, Optional
 
 import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
 
 
 class RequestStatus:
@@ -75,6 +78,86 @@ class DrainTimeout(RuntimeError):
     """``drain()`` exceeded ``drain_timeout_s`` without retiring the
     remaining work; the message carries per-slot diagnostics (slot id,
     request id, last dispatch age)."""
+
+
+class TokenStream:
+    """Thread-safe subscription to one request's per-token event stream
+    (``ServingEngine.token_events(rid)``).
+
+    The engine pushes events from the host-mirror drain point — one
+    event behind the device, flushed when a ``decode_block``'s tokens
+    are processed — so TTFT and time-between-tokens are observable per
+    request without ever synchronizing the dispatch path.  Events are
+    plain dicts:
+
+    - ``{"event": "token", "rid": r, "index": i, "token": t}`` — the
+      ``i``-th generated token (indices start at 0 with the admission
+      first-token; a resumed request replays its prior-incarnation
+      tokens first, so the stream is always the FULL generated
+      sequence).
+    - ``{"event": "end", "rid": r, "status": s, "detail": d}`` — the
+      typed terminal event, exactly once, last: ``COMPLETED`` |
+      ``SHED_DEADLINE`` | ``CANCELLED`` | ``ABORTED`` | ``PREEMPTED``
+      (preempted streams resume on a restarted server).
+
+    Subscribing mid-flight replays everything already generated, so the
+    stream is lossless regardless of when the consumer attaches.  The
+    producer side (``push``) runs under the engine lock in the
+    scheduler-owner thread; consumers (``get``/``events``/``tokens``)
+    may live on any thread.  ``on_event`` (optional) is invoked
+    synchronously from the producer for every event — the HTTP
+    transport uses it to bridge into an asyncio loop via
+    ``call_soon_threadsafe``; it must never block."""
+
+    def __init__(self, rid, on_event=None):
+        self.rid = rid
+        self._q = queue.SimpleQueue()
+        self._on_event = on_event
+
+    def push(self, event):
+        """Producer side (the serving engine, under its lock).  A dead
+        consumer must never break the producer: ``on_event`` raising
+        (e.g. ``call_soon_threadsafe`` into an asyncio loop that closed
+        mid-shutdown) drops the bridge — the queue keeps filling for
+        in-process readers, and ``close()``/``step()`` running this
+        under the engine lock survive."""
+        self._q.put(event)
+        cb = self._on_event
+        if cb is not None:
+            try:
+                cb(event)
+            except Exception as e:       # noqa: BLE001
+                self._on_event = None
+                logger.warning(
+                    f"serving: token-event subscriber for request "
+                    f"{self.rid} failed ({type(e).__name__}: {e}) — "
+                    f"bridge dropped, stream queue stays readable")
+
+    def get(self, timeout=None):
+        """The next event (blocking up to ``timeout`` seconds; raises
+        :class:`queue.Empty` on expiry)."""
+        return self._q.get(timeout=timeout)
+
+    def events(self, timeout=None):
+        """Yield events until — and including — the terminal ``end``
+        event.  ``timeout`` bounds EACH wait, not the whole stream."""
+        while True:
+            ev = self._q.get(timeout=timeout)
+            yield ev
+            if ev.get("event") == "end":
+                return
+
+    def tokens(self, timeout=None):
+        """Drain the stream to its end; returns ``(token_ids,
+        end_event)`` — the convenience form the streaming-equivalence
+        tests assert bitwise against the final ``RequestResult``."""
+        toks, end = [], None
+        for ev in self.events(timeout=timeout):
+            if ev.get("event") == "token":
+                toks.append(int(ev["token"]))
+            else:
+                end = ev
+        return toks, end
 
 
 class CircuitBreaker:
@@ -152,4 +235,5 @@ class CircuitBreaker:
 
 
 __all__ = ["RequestStatus", "TERMINAL_STATUSES", "RequestResult",
-           "QueueFull", "CircuitOpen", "DrainTimeout", "CircuitBreaker"]
+           "QueueFull", "CircuitOpen", "DrainTimeout", "CircuitBreaker",
+           "TokenStream"]

@@ -45,6 +45,10 @@ _ORCHESTRATORS = {
     "runtime.fwd_bwd_acc",      # gas>1 variant of fwd_bwd
     "inference.generate",       # host wrapper around inference.decode
     "hybrid.rollout_cast",      # once-per-optimizer-step view builder
+    # the HTTP front end's scheduler-owner loop drives the engine's
+    # locked serving programs and must never mint one of its own — the
+    # e2e zero-new-executables test (test_serving_frontend.py) proves it
+    "serving.http_frontend_loop",
 }
 
 

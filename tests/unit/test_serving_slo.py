@@ -130,7 +130,9 @@ def test_cancel_queued_and_running(served_engine):
     assert srv.cancel(r2) is True
     assert srv.result(r2).status == RequestStatus.CANCELLED
     assert srv.cancel(r2) is False, "terminal requests cannot re-cancel"
-    assert srv.cancel(10**9) is False
+    # an id this server never issued is a CLIENT error, not a no-op
+    with pytest.raises(KeyError, match="unknown request id"):
+        srv.cancel(10**9)
     # in-slot cancellation retires at this scheduling point
     while srv.active_slots == 0:
         srv.step()

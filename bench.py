@@ -768,6 +768,13 @@ def serving_http_bench(model_name="opt-1.3b", *, num_slots=8,
     t_http = time.perf_counter() - t1
     decode_execs.append(
         sum(1 for sig in eng._aot if sig and sig[0] == id(srv2._decode_fn)))
+    # engine-lock contention under concurrent HTTP handlers: per-acquire
+    # wait percentiles from the InstrumentedRLock sample window — the
+    # baseline future threading changes regress against (the PR 7
+    # threshold machinery classifies *_s as lower-is-better)
+    lock_waits = {cls: sorted(srv2._lock.samples[cls])
+                  for cls in ("scheduler", "handler")}
+    lock_wait_total = dict(srv2._lock.wait_s)
     srv2.close()
     if errors:
         raise RuntimeError("serving_http bench clients failed: "
@@ -792,6 +799,14 @@ def serving_http_bench(model_name="opt-1.3b", *, num_slots=8,
         "http_wire_ttft_p99_s": pct(wire_ttfts, 99),
         "http_time_between_tokens_p50_s": pct(tbt_gaps, 50),
         "http_time_between_tokens_p99_s": pct(tbt_gaps, 99),
+        "lock_wait_scheduler_p50_s": pct(lock_waits["scheduler"], 50),
+        "lock_wait_scheduler_p99_s": pct(lock_waits["scheduler"], 99),
+        "lock_wait_handler_p50_s": pct(lock_waits["handler"], 50),
+        "lock_wait_handler_p99_s": pct(lock_waits["handler"], 99),
+        "lock_wait_scheduler_total_s": round(
+            lock_wait_total["scheduler"], 4),
+        "lock_wait_handler_total_s": round(
+            lock_wait_total["handler"], 4),
         # < 1.0 = the transport costs throughput; the decode_block
         # flush cadence bounds per-token latency, not aggregate rate
         "http_vs_direct_reqs_ratio": round(
@@ -1435,7 +1450,8 @@ def _regression_direction(key):
     if "tokens_per_sec" in key or "tok_s" in key or key == "mfu" \
             or key.startswith("speedup") or key.endswith("_efficiency"):
         return 1
-    if key in ("step_time_s", "e2e_time_s") or key.startswith("ttft_"):
+    if key in ("step_time_s", "e2e_time_s") or "ttft_" in key \
+            or "time_between_tokens" in key or key.startswith("lock_wait_"):
         return -1
     return 0
 

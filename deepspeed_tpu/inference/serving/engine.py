@@ -82,6 +82,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.inference.serving.concurrency import (
+    InstrumentedRLock, checks_enabled, install_concurrency_checks)
 from deepspeed_tpu.inference.serving.config import ServingConfig
 from deepspeed_tpu.inference.serving.paging import (PagePool,
                                                     PagedPoolWorkspace,
@@ -199,6 +201,13 @@ class ServingEngine:
     greedy decoding, bitwise what ``engine.generate()`` returns for the
     same request solo)."""
 
+    # The concurrency contract (docs/tpu_lint.md "Concurrency
+    # contracts"): every mutable piece of scheduler state is declared
+    # lock-guarded in serving/concurrency.py GUARDED_FIELDS — tpu-lint's
+    # TL008 checks each source access statically, and
+    # DSTPU_CONCURRENCY_CHECKS=1 asserts the lock is held at runtime
+    # (__init__ tail below).
+
     def __init__(self, engine, monitor=None, **overrides):
         assert engine.params is not None, \
             "no parameters: set_params/init_params first"
@@ -242,9 +251,9 @@ class ServingEngine:
                 FairnessTracker
             self._fairness = FairnessTracker(
                 float(cfg.fairness_tokens_per_s),
-                float(cfg.fairness_window_s))
+                float(cfg.fairness_window_s))   # guarded-by: _lock
         else:
-            self._fairness = None
+            self._fairness = None               # guarded-by: _lock
         # ---- paged KV cache (docs/serving.md "Paged KV cache") ----
         self.paged = bool(cfg.paged)
         if self.paged:
@@ -342,36 +351,36 @@ class ServingEngine:
         self._lane_pool = _LanePool(self.module)
         if self.paged:
             self._pool_ws = PagedPoolWorkspace(self.module)
-            self._pool = PagePool(self.num_pages)
-            self._prefix = PrefixIndex()
+            self._pool = PagePool(self.num_pages)   # guarded-by: _lock
+            self._prefix = PrefixIndex()            # guarded-by: _lock
             # host-owned page tables, shipped as a traced arg on every
             # dispatch: [num_slots, pages_per_slot]; 0 = the trash page
             self._page_table = np.zeros(
-                (self.num_slots, self.n_slot_pages), np.int32)
-            self._slot_pages = {}        # slot -> [physical page ids]
-        self._cache = None
-        self._state = None               # device-resident slot state
+                (self.num_slots, self.n_slot_pages), np.int32)  # guarded-by: _lock
+            self._slot_pages = {}        # slot -> [page ids]  # guarded-by: _lock
+        self._cache = None               # guarded-by: _lock
+        self._state = None               # device-resident state  # guarded-by: _lock
         # host mirror of slot occupancy, updated as events are PROCESSED
         # (it lags the device by the in-flight events — by design)
-        self._mirror_active = np.zeros((self.num_slots,), bool)
-        self._slots = [None] * self.num_slots      # slot -> ServeRequest
-        self._free = deque(range(self.num_slots))
-        self._queue = deque()
-        self._pending = None
+        self._mirror_active = np.zeros((self.num_slots,), bool)  # guarded-by: _lock
+        self._slots = [None] * self.num_slots      # guarded-by: _lock
+        self._free = deque(range(self.num_slots))  # guarded-by: _lock
+        self._queue = deque()                      # guarded-by: _lock
+        self._pending = None                       # guarded-by: _lock
         # dispatched-but-unprocessed device work, processed FIFO one
         # event behind the newest dispatch: ("decode", toks_dev) |
         # ("admit", req, slot, lane, first_dev)
-        self._events = deque()
-        self._rng = jax.random.key(int(cfg.seed))
-        self._next_rid = 0
-        self._it = 0
+        self._events = deque()                     # guarded-by: _lock
+        self._rng = jax.random.key(int(cfg.seed))  # guarded-by: _lock
+        self._next_rid = 0                         # guarded-by: _lock
+        self._it = 0                               # guarded-by: _lock
         # ---- robustness / SLO state (docs/serving.md) ----
         if cfg.queue_policy not in ("reject", "block"):
             raise ValueError(f"serving.queue_policy={cfg.queue_policy!r}: "
                              f"one of 'reject', 'block'")
-        self._requests = {}              # rid -> ServeRequest (all known)
-        self._results = {}               # rid -> RequestResult (terminal)
-        self._pending_reports = {}       # rid -> None, merged into step()
+        self._requests = {}              # all known  # guarded-by: _lock
+        self._results = {}               # terminal   # guarded-by: _lock
+        self._pending_reports = {}       # -> step()  # guarded-by: _lock
         # ---- threading model (docs/serving.md "Network front end") ----
         # ONE lock guards every piece of mutable scheduler state (queue,
         # requests/results maps, slot mirror, stats, streams): submit()/
@@ -384,30 +393,45 @@ class ServingEngine:
         # one protocol is stateful across calls).  _cond lets blocked
         # submit()s (queue_policy="block" from a non-owner thread) wait
         # for the owner's next step instead of stepping themselves.
-        self._lock = threading.RLock()
+        # the engine lock also meters wall time spent waiting on it per
+        # thread class — Serving/lock_wait_s + /metrics (concurrency.py)
+        self._lock = InstrumentedRLock()
         self._cond = threading.Condition(self._lock)
-        self._owner_thread = None        # bound by the first step()
-        self._streams = {}               # rid -> [TokenStream]
+        self._owner_thread = None        # first step()  # guarded-by: _lock
+        self._streams = {}               # rid->[stream]  # guarded-by: _lock
         # set by submit()/restore() so an idle scheduler-owner loop
         # (frontend/transport.py) can sleep instead of busy-polling
         self.wake = threading.Event()
         self._breaker = CircuitBreaker(cfg.breaker_threshold,
                                        cfg.breaker_cooldown_s)
-        self._closed = False
-        self._close_report = []          # undrained rids close() reported
-        self._snap_seq = 0               # snapshot tag lineage counter
-        self._slot_last_dispatch = {}    # slot -> monotonic dispatch time
+        self._closed = False             # guarded-by: _lock
+        self._close_report = []          # undrained rids  # guarded-by: _lock
+        self._snap_seq = 0               # snapshot lineage  # guarded-by: _lock
+        self._slot_last_dispatch = {}    # slot -> mono t  # guarded-by: _lock
         # observability (docs/serving.md): scheduler counters + the
         # slot-occupancy trace the correctness test asserts EOS-mid-flight
         # retirement against
-        self.stats = {"iterations": 0, "decode_calls": 0,
+        self.stats = {"iterations": 0, "decode_calls": 0,  # guarded-by: _lock
                       "decode_tokens": 0, "prefill_tokens": 0,
                       "completed": 0, "admitted": 0, "wall_secs": 0.0,
                       "sync_secs": 0.0, "shed": 0, "cancelled": 0,
                       "resumed": 0, "prefix_lookups": 0, "prefix_hits": 0,
                       "prefix_tokens_reused": 0, "page_evictions": 0,
-                      "admission_stalls": 0, "fairness_rejected": 0}
-        self.occupancy_trace = []                  # (iteration, n_active)
+                      "admission_stalls": 0, "fairness_rejected": 0,
+                      "stream_bridge_drops": 0,
+                      "lock_wait_scheduler_s": 0.0,
+                      "lock_wait_handler_s": 0.0}
+        self.occupancy_trace = []        # (it, n_active)  # guarded-by: _lock
+        # classify lock waiters as scheduler vs handler; the ref is read
+        # AFTER a successful acquire, i.e. lock-held (concurrency.py)
+        self._lock._owner_ref = \
+            lambda: object.__getattribute__(self, "_owner_thread")
+        if checks_enabled():
+            # DSTPU_CONCURRENCY_CHECKS=1: every guarded-field access now
+            # asserts the lock is held — the runtime half of TL008, the
+            # interleaving stress harness drives serving traffic with
+            # this armed (tools/lint/interleave_check.py)
+            install_concurrency_checks(self)
 
     # ------------------------------------------------------------------ #
     # Public API
@@ -443,6 +467,7 @@ class ServingEngine:
         scheduler iterations inline when called from the scheduler-owner
         thread, and waits for the owner to free a spot otherwise), and
         :class:`~.slo.CircuitOpen` while the dispatch breaker is open."""
+        inject.fire("serving.pre_submit_lock")
         with self._lock:
             rid = self._submit_locked(input_ids, max_new_tokens,
                                       eos_token_id, deadline_s, client_id,
@@ -450,7 +475,7 @@ class ServingEngine:
         self.wake.set()                  # rouse an idle scheduler thread
         return rid
 
-    def _submit_locked(self, input_ids, max_new_tokens, eos_token_id,
+    def _submit_locked(self, input_ids, max_new_tokens, eos_token_id,  # lock-held: _lock
                        deadline_s, client_id, priority):
         if self._closed:
             raise RuntimeError(
@@ -511,7 +536,7 @@ class ServingEngine:
         self._requests[req.rid] = req
         return req.rid
 
-    def _apply_backpressure(self):
+    def _apply_backpressure(self):  # lock-held: _lock
         depth = int(self.config.max_queue_depth)
         if not depth or len(self._queue) < depth:
             return
@@ -549,12 +574,12 @@ class ServingEngine:
                     f"{self._breaker.last_error or 'circuit open'}")
             self.step()
 
-    def _no_block_progress(self):
+    def _no_block_progress(self):  # lock-held: _lock
         return self._breaker.open and not self._breaker.allow_dispatch() \
             and not (self._events or self._mirror_active.any()
                      or self._pending is not None)
 
-    def _known(self, rid, what):
+    def _known(self, rid, what):  # lock-held: _lock
         """The :class:`ServeRequest` for ``rid``, or a CLEAR ``KeyError``
         for ids this server never issued — a typo'd/stale rid must fail
         loudly, not look like a still-running request."""
@@ -573,6 +598,7 @@ class ServingEngine:
         ``CANCELLED``.  Returns ``False`` for already-terminal (or
         preempted) requests; raises ``KeyError`` for ids this server
         never issued.  Thread-safe."""
+        inject.fire("serving.pre_cancel_lock")
         with self._lock:
             req = self._known(rid, "cancel()")
             if req.status in TERMINAL_STATUSES \
@@ -627,9 +653,11 @@ class ServingEngine:
         transport passes ``loop.call_soon_threadsafe``); it must never
         block.  ``KeyError`` for ids this server never issued.
         Thread-safe."""
+        inject.fire("serving.pre_subscribe_lock")
         with self._lock:
             req = self._known(rid, "token_events()")
-            stream = TokenStream(rid, on_event=on_event)
+            stream = TokenStream(rid, on_event=on_event,
+                                 on_drop=self._count_stream_drop)
             for i, t in enumerate(req.tokens):
                 stream.push({"event": "token", "rid": rid,
                              "index": i, "token": int(t)})
@@ -644,7 +672,13 @@ class ServingEngine:
                 self._streams.setdefault(rid, []).append(stream)
             return stream
 
-    def _publish_progress(self, req):
+    def _count_stream_drop(self, rid, exc):  # lock-held: _lock
+        """Dropped subscriber-bridge accounting — pushes only ever run
+        under the engine lock, so the counter mutation inherits it (the
+        ``TokenStream.push`` contract; slo.py logs the warning_once)."""
+        self.stats["stream_bridge_drops"] += 1
+
+    def _publish_progress(self, req):  # lock-held: _lock
         """Push the request's not-yet-streamed tokens to every subscriber
         (called under the lock at the host-mirror drain points — the
         per-token stream is exactly the retirement bookkeeping's view,
@@ -659,7 +693,7 @@ class ServingEngine:
                     s.push(ev)
         req.streamed = n
 
-    def _publish_end(self, req, status, detail=""):
+    def _publish_end(self, req, status, detail=""):  # lock-held: _lock
         """The typed terminal event — exactly once, last; subscribers
         are dropped (late ``token_events()`` calls replay from the
         request record instead)."""
@@ -671,7 +705,7 @@ class ServingEngine:
             for s in streams:
                 s.push(ev)
 
-    def _release_slot_pages(self, slot):
+    def _release_slot_pages(self, slot):  # lock-held: _lock
         """Paged mode: return a retired slot's pages to the pool (shared
         prefix pages just drop one reference) and point its table row at
         the trash page — the NEXT dispatch's table redirects the zombie
@@ -687,7 +721,7 @@ class ServingEngine:
                 self._pool.decref(pg)
         self._page_table[int(slot), :] = 0
 
-    def _paging_reset(self):
+    def _paging_reset(self):  # lock-held: _lock
         """Drop EVERY page mapping (pool bookkeeping, prefix index, all
         table rows) — the pool buffer died with a failed dispatch or was
         just (re)allocated, so no indexed content survives."""
@@ -698,7 +732,7 @@ class ServingEngine:
         self._page_table[:] = 0
         self._slot_pages.clear()
 
-    def _retire_slot_host_side(self, req):
+    def _retire_slot_host_side(self, req):  # lock-held: _lock
         """Free a retired request's slot in the HOST MIRROR only — the
         device lane keeps masked-no-op decoding until the slot's next
         occupant's admit program overwrites its state wholesale (the same
@@ -713,7 +747,7 @@ class ServingEngine:
             self._free.append(int(s))
             self._release_slot_pages(s)
 
-    def _record_terminal(self, req, status, detail):
+    def _record_terminal(self, req, status, detail):  # lock-held: _lock
         """Mark a non-COMPLETED terminal outcome and queue it for the
         next ``step()`` return (output ``None``)."""
         req.status = status
@@ -729,7 +763,7 @@ class ServingEngine:
         # "end" can immediately read result(rid)
         self._publish_end(req, status, detail)
 
-    def _shed_expired(self):
+    def _shed_expired(self):  # lock-held: _lock
         """Deadline enforcement at the scheduling point: expired QUEUED
         requests are shed before admission (they never occupy a slot);
         expired pending-prefill / in-slot requests are retired host-side
@@ -836,10 +870,11 @@ class ServingEngine:
         (``step``/``drain``/``preempt``) becomes the scheduler owner and
         every other thread's call raises — see ``_check_owner``."""
         self._check_owner("step()")
+        inject.fire("serving.pre_step_lock")
         with self._lock:
             return self._step_locked()
 
-    def _step_locked(self):
+    def _step_locked(self):  # lock-held: _lock
         if self._closed:
             raise RuntimeError("step() on a closed ServingEngine")
         t0 = time.perf_counter()
@@ -873,6 +908,11 @@ class ServingEngine:
         # event unread so the device/tunnel keeps running while the host
         # does bookkeeping; once nothing new was dispatched, flush fully
         self._process_events(finished, keep=1 if dispatched else 0)
+        # lock-contention observability: cumulative wall time threads
+        # spent WAITING on the engine lock, scheduler vs handlers
+        # (InstrumentedRLock; exported via /metrics and Serving/ events)
+        self.stats["lock_wait_scheduler_s"] = self._lock.wait_s["scheduler"]
+        self.stats["lock_wait_handler_s"] = self._lock.wait_s["handler"]
         self._emit_metrics()
         self.stats["iterations"] += 1
         self.stats["wall_secs"] += time.perf_counter() - t0
@@ -901,25 +941,48 @@ class ServingEngine:
             timeout = timeout_s or None      # explicit 0 = no limit
         t0 = time.monotonic()
         results = {}
-        while self._queue or self._pending is not None or self._events \
-                or self._mirror_active.any():
+        while self._work_outstanding():
             if timeout is not None and time.monotonic() - t0 > timeout:
-                raise DrainTimeout(
-                    self._drain_diagnostics(timeout,
-                                            time.monotonic() - t0))
+                with self._lock:
+                    diag = self._drain_diagnostics(timeout,
+                                                   time.monotonic() - t0)
+                raise DrainTimeout(diag)
             if self._breaker.open and not self._breaker.allow_dispatch() \
-                    and not (self._events or self._mirror_active.any()):
+                    and not self._anything_in_flight():
                 # open breaker, nothing in flight: don't busy-spin the
                 # queue scan while waiting out the cooldown
                 time.sleep(min(
                     0.01, self._breaker.seconds_until_half_open()))
             results.update(self.step())
-        if self._pending_reports:
-            results.update(self._pending_reports)
-            self._pending_reports.clear()
+        with self._lock:
+            if self._pending_reports:
+                results.update(self._pending_reports)
+                self._pending_reports.clear()
         return results
 
-    def _drain_diagnostics(self, timeout, elapsed):
+    def _work_outstanding(self):
+        """True while anything submitted has not reached a terminal
+        status (queued, mid-prefill, in flight or mirror-active) — the
+        locked point-in-time view ``drain()`` loops on (its old unlocked
+        reads raced ``submit()``/``cancel()`` from other threads)."""
+        with self._lock:
+            return bool(self._queue or self._pending is not None
+                        or self._events or self._mirror_active.any())
+
+    def work_pending(self):
+        """Public combined scheduler predicate: anything queued,
+        mid-prefill, dispatched or mirror-live — ONE lock round-trip,
+        for driving loops (``frontend/transport.py``, ``resilient.py``)
+        that would otherwise take the lock three times per iteration
+        through the individual monitoring properties.  Thread-safe."""
+        return self._work_outstanding()
+
+    def _anything_in_flight(self):
+        """Locked: dispatched events unprocessed or mirror-live slots."""
+        with self._lock:
+            return bool(self._events or self._mirror_active.any())
+
+    def _drain_diagnostics(self, timeout, elapsed):  # lock-held: _lock
         now = time.monotonic()
         lines = [f"drain() exceeded its {timeout:.1f}s wall-clock budget "
                  f"({elapsed:.1f}s elapsed) with work outstanding: "
@@ -962,7 +1025,7 @@ class ServingEngine:
         with self._lock:
             return self._close_locked()
 
-    def _close_locked(self):
+    def _close_locked(self):  # lock-held: _lock
         if self._closed:
             return list(self._close_report)
         finished = {}
@@ -1006,7 +1069,7 @@ class ServingEngine:
                            f"request(s) {undrained} aborted")
         return list(self._close_report)
 
-    def _abort_in_flight(self, why):
+    def _abort_in_flight(self, why):  # lock-held: _lock
         """Drop every request past admission (its KV rows live in buffers
         that are dead or about to be re-initialized) and restore the slot
         bookkeeping to all-free — queued requests survive and the next
@@ -1041,30 +1104,70 @@ class ServingEngine:
             logger.warning(f"serving {why}: aborted {len(lost)} in-flight "
                            f"request(s) {lost} — queued requests survive")
 
+    # Monitoring properties take the engine lock (re-entrant, so locked
+    # callers like _emit_metrics/_metrics_body compose): an unlocked
+    # read would race the scheduler mutating the same state — the
+    # "/metrics iterating fairness state while the scheduler compacted
+    # it" bug class TL008 exists to kill.
     @property
     def queue_depth(self):
-        return len(self._queue) + (1 if self._pending is not None else 0)
+        with self._lock:
+            return len(self._queue) + (1 if self._pending is not None
+                                       else 0)
 
     @property
     def active_slots(self):
         """Live slots as of the last PROCESSED event (the host mirror)."""
-        return int(np.sum(self._mirror_active))
+        with self._lock:
+            return int(np.sum(self._mirror_active))
 
     @property
     def in_flight(self):
         """Dispatched device events not yet processed."""
-        return len(self._events)
+        with self._lock:
+            return len(self._events)
 
     @property
     def page_pool_utilization(self):
         """Allocated fraction of the page pool (0.0 when not paged)."""
-        return self._pool.utilization() if self.paged else 0.0
+        with self._lock:
+            return self._pool.utilization() if self.paged else 0.0
 
     @property
     def prefix_hit_rate(self):
         """Fraction of prefix-cache lookups that matched >= 1 page."""
-        n = self.stats["prefix_lookups"]
-        return self.stats["prefix_hits"] / n if n else 0.0
+        with self._lock:
+            n = self.stats["prefix_lookups"]
+            return self.stats["prefix_hits"] / n if n else 0.0
+
+    def health_snapshot(self):
+        """One locked point-in-time view of the scheduler for health
+        endpoints (``/healthz``): queue depth, mirror occupancy,
+        in-flight events, breaker state, closed flag.  Thread-safe —
+        the HTTP front end calls it through ``run_in_executor`` so the
+        loop thread never blocks on the engine lock itself."""
+        with self._lock:
+            # the properties re-enter the already-held lock (re-entrant
+            # acquires are excluded from the wait samples), so /healthz
+            # and the property/metrics view share ONE implementation
+            snap = {
+                "closed": self._closed,
+                "queue_depth": self.queue_depth,
+                "active_slots": self.active_slots,
+                "num_slots": self.num_slots,
+                "in_flight_events": self.in_flight,
+                "breaker": {
+                    "open": self._breaker.open,
+                    "consecutive_failures":
+                        self._breaker.consecutive_failures,
+                    "trips": self._breaker.trips,
+                    "last_error": self._breaker.last_error,
+                },
+            }
+            snap["slot_occupancy"] = snap["active_slots"] / self.num_slots
+            if self.paged:
+                snap["page_pool_utilization"] = self.page_pool_utilization
+            return snap
 
     # ------------------------------------------------------------------ #
     # Warmup — compile (or reload) the expensive programs up front
@@ -1156,7 +1259,7 @@ class ServingEngine:
     # ------------------------------------------------------------------ #
     # Admission: queue -> prefill chunks -> fused admit dispatch
     # ------------------------------------------------------------------ #
-    def _pop_request(self):
+    def _pop_request(self):  # lock-held: _lock
         if self.priority_lanes > 1:
             return self._pop_request_priority()
         if self.config.admission == "shortest_first":
@@ -1165,7 +1268,7 @@ class ServingEngine:
             return req
         return self._queue.popleft()
 
-    def _pop_request_priority(self):
+    def _pop_request_priority(self):  # lock-held: _lock
         """Priority lanes over the base admission order: pop the lowest
         EFFECTIVE lane, breaking ties with the configured policy (queue
         position for fcfs, prompt length for shortest_first).  Effective
@@ -1191,7 +1294,7 @@ class ServingEngine:
         self._queue.remove(req)
         return req
 
-    def _admit(self):
+    def _admit(self):  # lock-held: _lock
         limit = self.config.prefill_token_budget or math.inf
         spent = 0
         while spent < limit:
@@ -1226,7 +1329,7 @@ class ServingEngine:
                 pend, self._pending = self._pending, None
                 self._dispatch_admit(pend)
 
-    def _start_prefill(self, req):
+    def _start_prefill(self, req):  # lock-held: _lock
         fill = req.fill_ids              # prompt + any resumed tokens
         P = len(fill)
         if self.paged:
@@ -1241,7 +1344,7 @@ class ServingEngine:
                                     self.engine.compute_dtype)
         return _PendingPrefill(req, slot, lane, ids_pad, n, P)
 
-    def _start_prefill_paged(self, req, fill, P):
+    def _start_prefill_paged(self, req, fill, P):  # lock-held: _lock
         """Paged admission: map the longest indexed prefix (full pages,
         refcounted — prefilled ONCE per unique prefix), allocate private
         pages for the rest of the virtual lane, and prefill only from
@@ -1305,7 +1408,7 @@ class ServingEngine:
         pend.fill_tokens = fill
         return pend
 
-    def _run_prefill_chunk(self, p):
+    def _run_prefill_chunk(self, p):  # lock-held: _lock
         C = self.chunk
         P = p.fill_len
         # chunk ci covers absolute positions [start + ci*C, start +
@@ -1369,7 +1472,7 @@ class ServingEngine:
         self.stats["prefill_tokens"] += C
         return p.ci >= p.n_chunks
 
-    def _dispatch_admit(self, p):
+    def _dispatch_admit(self, p):  # lock-held: _lock
         """Prefill complete: ONE fused dispatch samples the first token,
         inserts the lane and writes the slot state in-program.  The first
         token is read lazily when the event is processed.  A resumed
@@ -1436,7 +1539,7 @@ class ServingEngine:
     # ------------------------------------------------------------------ #
     # Decode: one block of the single reusable decode-step program
     # ------------------------------------------------------------------ #
-    def _dispatch_decode(self):
+    def _dispatch_decode(self):  # lock-held: _lock
         # dispatch when anything can be live on device: a slot active as
         # of the mirror, or an unprocessed admit that (probably) went live
         if not (self._mirror_active.any()
@@ -1479,15 +1582,16 @@ class ServingEngine:
     # ------------------------------------------------------------------ #
     # Event processing (the host's lagging mirror of the device)
     # ------------------------------------------------------------------ #
-    def _process_events(self, finished, keep=0):
+    def _process_events(self, finished, keep=0):  # lock-held: _lock
         while len(self._events) > keep:
+            inject.fire("serving.mirror_drain")
             ev = self._events.popleft()
             if ev[0] == "admit":
                 self._process_admit(ev, finished)
             else:
                 self._process_decode(ev, finished)
 
-    def _process_admit(self, ev, finished):
+    def _process_admit(self, ev, finished):  # lock-held: _lock
         _, req, slot, lane, first_dev = ev
         t0 = time.perf_counter()
         first = int(np.asarray(first_dev))
@@ -1521,7 +1625,7 @@ class ServingEngine:
             self._mirror_active[slot] = True
             self._publish_progress(req)
 
-    def _process_decode(self, ev, finished):
+    def _process_decode(self, ev, finished):  # lock-held: _lock
         t0 = time.perf_counter()
         toks = np.asarray(ev[1])                         # [block, N]
         self.stats["sync_secs"] += time.perf_counter() - t0
@@ -1551,7 +1655,7 @@ class ServingEngine:
         self.occupancy_trace.append(
             (self._it, int(self._mirror_active.sum())))
 
-    def _finalize(self, req):
+    def _finalize(self, req):  # lock-held: _lock
         """The ``generate()`` output contract: ``[prompt..., tokens...]``
         of length ``P + max_new_tokens``, eos-padded past an early stop.
         For resumed requests ``tokens`` already includes the prefix, so
@@ -1576,7 +1680,7 @@ class ServingEngine:
     # ------------------------------------------------------------------ #
     # Graceful preemption: drain -> crash-atomic snapshot -> resume
     # ------------------------------------------------------------------ #
-    def _undrained_requests(self):
+    def _undrained_requests(self):  # lock-held: _lock
         """Every request that would be lost if the process died now:
         in-slot (non-terminal), mid-admission, and queued — in a stable
         order (slots, pending, queue)."""
@@ -1607,7 +1711,7 @@ class ServingEngine:
             return self._preempt_locked(checkpoint_dir, drain_budget_s,
                                         tag)
 
-    def _preempt_locked(self, checkpoint_dir, drain_budget_s, tag):
+    def _preempt_locked(self, checkpoint_dir, drain_budget_s, tag):  # lock-held: _lock
         if self._closed:
             raise RuntimeError("preempt() on a closed ServingEngine")
         budget = self.config.drain_budget_s if drain_budget_s is None \
@@ -1694,7 +1798,13 @@ class ServingEngine:
         serving analog of a training checkpoint (staging dir, manifest
         with checksums, fsync, atomic rename, ``latest`` swap; see
         ``inference/serving/snapshot.py``).  Pure write: the engine's
-        bookkeeping is untouched.  Returns the tag."""
+        bookkeeping is untouched.  Returns the tag.  Thread-safe (the
+        state walk runs under the engine lock; ``preempt()`` re-enters
+        it lock-held)."""
+        with self._lock:
+            return self._snapshot_locked(checkpoint_dir, tag)
+
+    def _snapshot_locked(self, checkpoint_dir, tag):  # lock-held: _lock
         from deepspeed_tpu.inference.serving.snapshot import save_snapshot
         self._snap_seq += 1
         tag = tag or f"serving_{self._snap_seq}"
@@ -1772,7 +1882,7 @@ class ServingEngine:
         self.wake.set()                  # rouse an idle scheduler thread
         return rids
 
-    def _restore_locked(self, tag, state):
+    def _restore_locked(self, tag, state):  # lock-held: _lock
         self._snap_seq = max(self._snap_seq, int(state.get("seq", 0)))
         if self._fairness is not None and state.get("fairness"):
             self._fairness.load_state(state["fairness"])
@@ -1871,7 +1981,7 @@ class ServingEngine:
     # ------------------------------------------------------------------ #
     # Plumbing
     # ------------------------------------------------------------------ #
-    def _ensure_workspace(self):
+    def _ensure_workspace(self):  # lock-held: _lock
         if self._cache is None:
             if self.paged:
                 self._cache = self._pool_ws.take(
@@ -1888,7 +1998,7 @@ class ServingEngine:
                            init_slot_state(self.num_slots).items()}
             self._mirror_active[:] = False
 
-    def _emit_metrics(self):
+    def _emit_metrics(self):  # lock-held: _lock
         mon = self.monitor
         if mon is None or not getattr(mon, "enabled", True):
             return
@@ -1909,6 +2019,10 @@ class ServingEngine:
             ("Serving/aborted", self.stats.get("aborted", 0), self._it),
             ("Serving/breaker_open",
              1.0 if self._breaker.open else 0.0, self._it),
+            ("Serving/lock_wait_scheduler_s",
+             self.stats["lock_wait_scheduler_s"], self._it),
+            ("Serving/lock_wait_handler_s",
+             self.stats["lock_wait_handler_s"], self._it),
         ] + ([
             ("Serving/fairness_rejected",
              self.stats["fairness_rejected"], self._it),

@@ -18,6 +18,15 @@ TTFT stays bounded while only the heavy client sheds).
 Host bookkeeping only — all calls run under the serving engine's lock,
 and the state round-trips preemption snapshots so a restarted server
 keeps enforcing the same quotas.
+
+Concurrency contract: the tracker deliberately has NO lock of its own.
+It is reachable only through the engine's ``_fairness`` attribute,
+which is declared lock-guarded in the registry
+(``inference/serving/concurrency.py`` — TL008 +
+``DSTPU_CONCURRENCY_CHECKS``), so every window read/write inherits the
+engine lock transitively; ``window_usage()`` compacts the map IN PLACE,
+which is exactly why an unlocked iteration (the original ``/metrics``
+bug) is unsafe.
 """
 
 import math

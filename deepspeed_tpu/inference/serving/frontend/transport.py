@@ -232,7 +232,7 @@ class ServingHTTPFrontend:
                 if self._preempt.is_set():
                     self._do_preempt()
                     return
-                if srv.queue_depth or srv.active_slots or srv.in_flight:
+                if srv.work_pending():   # one lock round-trip, not three
                     srv.step()
                 else:
                     srv.wake.wait(timeout=self.idle_poll_s)
@@ -313,8 +313,8 @@ class ServingHTTPFrontend:
         for sig, prev in self._prev_handlers.items():
             signal.signal(sig, prev)
         self._prev_handlers.clear()
-        if close_engine and not self.srv._closed:
-            self.srv.close()
+        if close_engine:
+            self.srv.close()             # idempotent; takes its own lock
 
     def join_preempted(self, timeout=60):
         """Block until the scheduler thread has finished a requested
@@ -593,30 +593,20 @@ class ServingHTTPFrontend:
     # /healthz and /metrics
     # ------------------------------------------------------------------ #
     async def _healthz(self, writer):
-        srv = self.srv
-        closed = srv._closed
+        # ONE locked engine snapshot, taken off the loop thread: piecing
+        # the payload together from unlocked field reads both raced the
+        # scheduler and (worse) blocked the event loop on the engine
+        # lock across a step() — the TL008/TL009 bug classes
+        snap = await asyncio.get_running_loop().run_in_executor(
+            None, self.srv.health_snapshot)
         payload = {
-            "ok": not closed and self._sched_error is None,
-            "closed": closed,
+            "ok": not snap["closed"] and self._sched_error is None,
             "uptime_s": round(time.monotonic() - self._t0, 3),
-            "queue_depth": srv.queue_depth,
-            "active_slots": srv.active_slots,
-            "num_slots": srv.num_slots,
-            "slot_occupancy": srv.active_slots / srv.num_slots,
-            "in_flight_events": srv.in_flight,
-            "breaker": {
-                "open": srv._breaker.open,
-                "consecutive_failures":
-                    srv._breaker.consecutive_failures,
-                "trips": srv._breaker.trips,
-                "last_error": srv._breaker.last_error,
-            },
+            **snap,
             "scheduler_error": self._sched_error,
         }
-        if srv.paged:
-            payload["page_pool_utilization"] = srv.page_pool_utilization
-        return await self._respond(writer, 503 if closed else 200,
-                                   payload)
+        return await self._respond(
+            writer, 503 if snap["closed"] else 200, payload)
 
     def _metrics_body(self):
         """Render the Prometheus text (runs in an executor thread; the
@@ -626,6 +616,7 @@ class ServingHTTPFrontend:
         srv = self.srv
         with srv._lock:
             stats = dict(srv.stats)
+            lock_wait = dict(srv._lock.wait_s)
             snap = {
                 "queue_depth": srv.queue_depth,
                 "active_slots": srv.active_slots,
@@ -660,6 +651,14 @@ class ServingHTTPFrontend:
               "dispatch circuit breaker state")
         gauge("uptime_seconds", time.monotonic() - self._t0,
               "front-end uptime")
+        lines.append("# HELP dstpu_serving_lock_wait_seconds cumulative "
+                     "wall time waiting on the engine lock per thread "
+                     "class")
+        lines.append("# TYPE dstpu_serving_lock_wait_seconds gauge")
+        for cls in sorted(lock_wait):
+            lines.append(f'dstpu_serving_lock_wait_seconds'
+                         f'{{thread_class="{cls}"}} '
+                         f'{float(lock_wait[cls])}')
         if snap["paged_util"] is not None:
             gauge("page_pool_utilization", snap["paged_util"],
                   "allocated fraction of the KV page pool")

@@ -39,7 +39,7 @@ def serve_resilient(srv, checkpoint_dir, agent=None, resume=True):
     try:
         if resume:
             srv.restore(checkpoint_dir)
-        while srv.queue_depth or srv.in_flight or srv.active_slots:
+        while srv.work_pending():
             if agent.preempted:
                 break
             results.update(srv.step())

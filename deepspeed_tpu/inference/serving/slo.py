@@ -109,10 +109,11 @@ class TokenStream:
     transport uses it to bridge into an asyncio loop via
     ``call_soon_threadsafe``; it must never block."""
 
-    def __init__(self, rid, on_event=None):
+    def __init__(self, rid, on_event=None, on_drop=None):
         self.rid = rid
         self._q = queue.SimpleQueue()
         self._on_event = on_event
+        self._on_drop = on_drop          # engine hook: count the drop
 
     def push(self, event):
         """Producer side (the serving engine, under its lock).  A dead
@@ -120,7 +121,10 @@ class TokenStream:
         (e.g. ``call_soon_threadsafe`` into an asyncio loop that closed
         mid-shutdown) drops the bridge — the queue keeps filling for
         in-process readers, and ``close()``/``step()`` running this
-        under the engine lock survive."""
+        under the engine lock survive.  The drop is never silent: a
+        ``warning_once`` names the rid and exception class (shutdown
+        races stay diagnosable) and ``on_drop`` lets the engine count
+        it in ``stats["stream_bridge_drops"]``."""
         self._q.put(event)
         cb = self._on_event
         if cb is not None:
@@ -128,10 +132,21 @@ class TokenStream:
                 cb(event)
             except Exception as e:       # noqa: BLE001
                 self._on_event = None
+                # once per STREAM structurally (the bridge is nulled
+                # right here) — warning_once's process-global seen-set
+                # would retain one interned per-rid string forever on a
+                # long-lived server, for no extra dedup
                 logger.warning(
-                    f"serving: token-event subscriber for request "
-                    f"{self.rid} failed ({type(e).__name__}: {e}) — "
-                    f"bridge dropped, stream queue stays readable")
+                    f"serving: token-event subscriber bridge for "
+                    f"request {self.rid} dropped on "
+                    f"{type(e).__name__}: {e} — stream queue stays "
+                    f"readable; counted in stats['stream_bridge_drops']")
+                if self._on_drop is not None:
+                    try:
+                        self._on_drop(self.rid, e)
+                    except Exception:    # noqa: BLE001 — never re-raise
+                        logger.warning("serving: stream-drop accounting "
+                                       "hook failed; drop uncounted")
 
     def get(self, timeout=None):
         """The next event (blocking up to ``timeout`` seconds; raises

@@ -37,6 +37,20 @@ point                                 seam
 ``serving.sigterm_at_iter``           top of every serving scheduler
                                       iteration (``sigterm``-at-iter-K:
                                       the graceful-preemption proof)
+``serving.pre_step_lock``             in ``step()``, after the owner
+                                      check, before the engine lock —
+                                      a ``yield`` here perturbs the
+                                      scheduler-vs-handler acquisition
+                                      order
+``serving.pre_submit_lock``           in ``submit()`` before the lock
+``serving.pre_cancel_lock``           in ``cancel()`` before the lock
+``serving.pre_subscribe_lock``        in ``token_events()`` before the
+                                      lock
+``serving.mirror_drain``              per event popped in the host
+                                      mirror's drain loop (lock held —
+                                      a ``yield`` here stretches the
+                                      retirement window other threads
+                                      contend against)
 ====================================  ====================================
 
 Arm points programmatically (:func:`configure_injection`) or via the
@@ -63,13 +77,20 @@ Actions:
   heartbeat watchdog.
 * ``corrupt`` — flip bytes in the largest file under the ``path`` the seam
   provides (array shard): manifest verification must catch it.
+* ``yield`` — sleep a RANDOMIZED ``[0, seconds]`` interval drawn from a
+  deterministic per-spec RNG (``seed`` field): the interleaving stress
+  harness (``tools/lint/interleave_check.py``) arms this at the serving
+  lock seams to force different thread schedules per seed while staying
+  reproducible.
 
 ``fire()`` is a dict-lookup no-op when nothing is armed — it is safe on
 hot-ish paths like the supervisor step loop.
 """
 
 import os
+import random
 import signal
+import threading
 import time
 
 from deepspeed_tpu.utils.logging import logger
@@ -89,6 +110,11 @@ INJECTION_POINTS = (
     "serving.pre_decode_dispatch",
     "serving.mid_drain",
     "serving.sigterm_at_iter",
+    "serving.pre_step_lock",
+    "serving.pre_submit_lock",
+    "serving.pre_cancel_lock",
+    "serving.pre_subscribe_lock",
+    "serving.mirror_drain",
 )
 
 
@@ -98,14 +124,15 @@ class InjectedFault(IOError):
 
 class _Spec:
     __slots__ = ("point", "action", "at", "times", "seconds", "exit_code",
-                 "hits", "fired")
+                 "seed", "rng", "hits", "fired")
 
     def __init__(self, point, action="raise", at=1, times=1, seconds=3600.0,
-                 exit_code=17):
+                 exit_code=17, seed=0):
         if point not in INJECTION_POINTS:
             raise ValueError(f"unknown injection point {point!r}; one of "
                              f"{INJECTION_POINTS}")
-        if action not in ("exit", "raise", "sigterm", "hang", "corrupt"):
+        if action not in ("exit", "raise", "sigterm", "hang", "corrupt",
+                          "yield"):
             raise ValueError(f"unknown injection action {action!r}")
         self.point = point
         self.action = action
@@ -113,8 +140,22 @@ class _Spec:
         self.times = int(times)
         self.seconds = float(seconds)
         self.exit_code = int(exit_code)
+        self.seed = int(seed)
+        # yield draws: one RNG PER FIRING THREAD (keyed by thread name,
+        # seeded from spec seed + name) — a shared stream would hand
+        # draws to threads in OS-scheduling order, breaking the
+        # reproduce-from-the-same-seed contract on multi-threaded seams
+        self.rng = {}
         self.hits = 0
         self.fired = 0
+
+    def yield_rng(self):
+        name = threading.current_thread().name
+        rng = self.rng.get(name)
+        if rng is None:
+            rng = self.rng.setdefault(name,
+                                      random.Random(f"{self.seed}:{name}"))
+        return rng
 
 
 _armed = {}          # point -> list[_Spec]
@@ -176,23 +217,41 @@ def active():
     return bool(_armed)
 
 
+_fire_lock = threading.Lock()
+
+
 def fire(point, path=None):
-    """Hit an injection point.  No-op unless a spec is armed for it."""
+    """Hit an injection point.  No-op unless a spec is armed for it.
+    Spec bookkeeping (``hits``/``fired``) is locked: the serving lock
+    seams fire from several threads concurrently, and an unsynchronized
+    check-then-act would let an ``at``/``times``-limited spec fire twice
+    (or lose hits).  The action itself runs OUTSIDE the lock — it may
+    sleep, raise or never return."""
     _load_env()
     specs = _armed.get(point)
     if not specs:
         return
-    for spec in specs:
-        spec.hits += 1
-        if spec.hits < spec.at:
-            continue
-        if spec.times and spec.fired >= spec.times:
-            continue
-        spec.fired += 1
+    to_run = []
+    with _fire_lock:
+        for spec in specs:
+            spec.hits += 1
+            if spec.hits < spec.at:
+                continue
+            if spec.times and spec.fired >= spec.times:
+                continue
+            spec.fired += 1
+            to_run.append(spec)
+    for spec in to_run:
         _execute(spec, path)
 
 
 def _execute(spec, path):
+    if spec.action == "yield":
+        # fires on EVERY hit of a hot seam — no per-fire log spam, and
+        # the sleep is a deterministic per-thread draw so a failing
+        # interleaving reproduces from the same seed
+        time.sleep(spec.yield_rng().random() * spec.seconds)
+        return
     logger.warning(f"[fault] injection FIRING: {spec.point} -> "
                    f"{spec.action} (hit {spec.hits})")
     if spec.action == "exit":

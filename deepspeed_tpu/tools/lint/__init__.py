@@ -15,6 +15,16 @@ Rules (each suppressible per line or per function via
   paired with the runtime retrace counter
   (:mod:`deepspeed_tpu.tools.lint.retrace_check`)
 * **TL007** variable read after being passed in a donated position
+* **TL008** lock-guarded serving field accessed outside ``with
+  self._lock`` (or a ``# lock-held:`` annotated method) — declared via
+  the ``GUARDED_FIELDS`` registry / ``# guarded-by:`` comments; paired
+  with the ``DSTPU_CONCURRENCY_CHECKS=1`` runtime assertions and the
+  interleaving stress harness
+  (:mod:`deepspeed_tpu.tools.lint.interleave_check`)
+* **TL009** lock-taking engine call on the asyncio loop thread not
+  routed through ``run_in_executor``, or an owner-bound driving method
+  (``step``/``drain``/``preempt``) in a context that can never be the
+  scheduler owner
 
 CLI: ``python -m deepspeed_tpu.tools.lint [paths]`` (or ``bin/ds_lint``);
 exits non-zero when any unsuppressed finding remains.  ``--jaxpr`` runs
@@ -23,7 +33,8 @@ which traces the registered hot-path entry points and verifies — at the
 compiler level — that they contain no host callbacks and that declared
 donations actually alias.  ``--contracts [--update]`` regenerates the
 program-contract lockfile (:mod:`deepspeed_tpu.tools.lint.contract`,
-``PROGRAMS.lock``) and diffs it per program.
+``PROGRAMS.lock``) and diffs it per program.  ``--concurrency`` runs the
+TL008/TL009 sweep and, when clean, the interleaving stress harness.
 """
 
 from deepspeed_tpu.tools.lint.core import Finding, RULES, run_lint  # noqa: F401

@@ -28,6 +28,13 @@ def main(argv=None):
     parser.add_argument("--contracts", action="store_true",
                         help="regenerate every program contract and diff "
                              "against PROGRAMS.lock (exit 1 on a break)")
+    parser.add_argument("--concurrency", action="store_true",
+                        help="run the concurrency-contract gate: the "
+                             "TL008/TL009 lock-discipline sweep over the "
+                             "given paths (default: the installed "
+                             "package), then — when the sweep is clean — "
+                             "the interleaving stress harness under "
+                             "DSTPU_CONCURRENCY_CHECKS=1")
     parser.add_argument("--update", action="store_true",
                         help="with --contracts: rewrite PROGRAMS.lock "
                              "from the freshly extracted contracts")
@@ -45,6 +52,26 @@ def main(argv=None):
         from deepspeed_tpu.tools.lint import contract, jaxpr_check
         contract.ensure_harness_env()
         return jaxpr_check.main()
+    if args.concurrency:
+        # the tier-1 env is forced like --contracts/--jaxpr so the CLI
+        # and the CI gate agree on what they check
+        from deepspeed_tpu.tools.lint import contract, interleave_check
+        contract.ensure_harness_env()
+        paths = args.paths
+        if not paths:
+            import deepspeed_tpu
+            paths = [os.path.dirname(
+                os.path.abspath(deepspeed_tpu.__file__))]
+        findings, stats = run_lint(paths, rules={"TL008", "TL009"})
+        for f in findings:
+            print(f)
+        suppressed = sum(stats["suppressed"].values())
+        print(f"tpu-lint[concurrency]: {len(findings)} finding(s), "
+              f"{suppressed} suppressed, {stats['files']} file(s) "
+              f"checked")
+        if findings:
+            return 1                 # static break: skip the slow prover
+        return interleave_check.main()
 
     if args.list_rules:
         from deepspeed_tpu.tools.lint import rules as _r  # noqa: F401

@@ -1,0 +1,327 @@
+"""Interleaving stress harness — the runtime prover paired with the
+TL008/TL009 lock-discipline rules (the same "rule + prover" pairing
+TL006 ships with its retrace counter).
+
+The static rules prove every DECLARED access site is lock-correct; this
+harness proves the contract actually holds under adversarial thread
+schedules: it drives concurrent ``submit`` / ``cancel`` / ``status`` /
+``token_events`` / metrics-snapshot traffic against a stepping scheduler
+thread while RANDOMIZED yields (the fault registry's ``yield`` action,
+deterministic per seed) are injected at the named lock seams —
+``serving.pre_step_lock``, ``serving.pre_submit_lock``,
+``serving.pre_cancel_lock``, ``serving.pre_subscribe_lock`` and the
+lock-held ``serving.mirror_drain`` — so every run explores a different
+acquisition interleaving, reproducibly.
+
+Run with ``DSTPU_CONCURRENCY_CHECKS=1`` (the default here), every
+guarded-field access additionally asserts the engine lock is held
+(``serving/concurrency.py``); a single unlocked touch anywhere in the
+interleaving surfaces as a :class:`ConcurrencyViolation` and fails the
+harness.  The invariants asserted per seed:
+
+* **bitwise serving** — every never-cancelled request's COMPLETED output
+  is bitwise-identical to a sequential reference run of the same
+  workload (admission order and slot churn may differ; outputs may not);
+* **exactly one terminal status** per request (cancel racing the mirror
+  drain's retirement must resolve to COMPLETED xor CANCELLED — never
+  both, never a KeyError);
+* **lossless streams** — a mid-flight ``token_events`` subscription
+  drains to exactly the request's final generated tokens plus one typed
+  ``end`` event;
+* **zero guarded-field assertion trips** and no thread died.
+
+Tier-1 via ``tests/unit/test_serving_concurrency.py``; also the runtime
+half of ``ds_lint --concurrency``.  ``main()`` is the CLI entry point.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+
+YIELD_SEAMS = ("serving.pre_step_lock", "serving.pre_submit_lock",
+               "serving.pre_cancel_lock", "serving.pre_subscribe_lock",
+               "serving.mirror_drain")
+
+TERMINAL = ("COMPLETED", "SHED_DEADLINE", "CANCELLED", "ABORTED")
+
+
+def _tiny_served_engine(seed=0):
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import (Transformer,
+                                                  TransformerConfig)
+    cfg = TransformerConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                            num_heads=4, max_seq_len=64,
+                            use_flash_attention=False, dtype="float32")
+    model = Transformer(cfg)
+    ids = jnp.asarray(np.random.default_rng(seed).integers(0, 97, (2, 12)),
+                      jnp.int32)
+    params = model.init(jax.random.key(0), {"input_ids": ids})
+    eng = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32", "prefill_chunk_size": 8,
+                       "serving": {"enabled": True, "num_slots": 2,
+                                   "max_cache_len": 48, "prefill_chunk": 8,
+                                   "prefill_token_budget": 16,
+                                   "decode_block": 2,
+                                   # fairness ON so the metrics thread
+                                   # iterates live window state — the
+                                   # /metrics-vs-compaction race surface
+                                   "fairness_tokens_per_s": 1e6,
+                                   "fairness_window_s": 10.0}})
+    eng.set_params(params)
+    return eng
+
+
+def _workload(rng, n_keep, n_victims):
+    reqs = []
+    for i in range(n_keep + n_victims):
+        plen = int(rng.integers(8, 20))
+        reqs.append({
+            "idx": i,
+            "prompt": rng.integers(1, 97, (plen,)).astype(np.int32),
+            "max_new": int(rng.integers(3, 9)),
+            "eos": -1 if i % 2 else 96,
+            "client": f"tenant-{i % 2}",
+            "victim": i >= n_keep,
+        })
+    return reqs
+
+
+def _reference_outputs(eng, reqs):
+    """Sequential single-threaded serve of the keep requests — the
+    bitwise baseline the concurrent run must reproduce."""
+    srv = eng.serve()
+    rids = {r["idx"]: srv.submit(r["prompt"], max_new_tokens=r["max_new"],
+                                 eos_token_id=r["eos"],
+                                 client_id=r["client"])
+            for r in reqs if not r["victim"]}
+    srv.drain()
+    ref = {idx: srv.result(rid).output for idx, rid in rids.items()}
+    srv.close()
+    return ref
+
+
+def _run_one_seed(eng, reqs, ref, seed, yield_s):
+    from deepspeed_tpu.runtime.fault import inject
+    problems = []
+    errors = []                          # (thread, repr) — any means FAIL
+    rid_of = {}                          # idx -> rid
+    harness_lock = threading.Lock()
+    rid_ready = threading.Event()
+    stop = threading.Event()
+
+    inject.reset_injection()
+    inject.configure_injection([
+        {"point": p, "action": "yield", "at": 1, "times": 0,
+         "seconds": yield_s, "seed": seed + i}
+        for i, p in enumerate(YIELD_SEAMS)])
+    srv = eng.serve()
+    rng = np.random.default_rng(1000 + seed)
+
+    def guard(name, fn):
+        def run():
+            try:
+                fn()
+            except Exception as e:       # noqa: BLE001 — the verdict
+                errors.append((name, f"{type(e).__name__}: {e}"))
+                stop.set()
+        return threading.Thread(target=run, name=f"ilv-{name}",
+                                daemon=True)
+
+    def scheduler():
+        srv.bind_owner()
+        while not stop.is_set():
+            if srv.work_pending():
+                srv.step()
+            else:
+                srv.wake.wait(timeout=0.005)
+                srv.wake.clear()
+
+    def submitter(share):
+        local = np.random.default_rng(2000 + seed + share)
+        for r in reqs[share::2]:
+            time.sleep(float(local.random()) * yield_s)
+            rid = srv.submit(r["prompt"], max_new_tokens=r["max_new"],
+                             eos_token_id=r["eos"], client_id=r["client"])
+            with harness_lock:
+                rid_of[r["idx"]] = rid
+                if len(rid_of) == len(reqs):
+                    rid_ready.set()
+
+    def canceller():
+        local = np.random.default_rng(3000 + seed)
+        rid_ready.wait(timeout=60)
+        victims = [r["idx"] for r in reqs if r["victim"]]
+        for idx in victims:
+            time.sleep(float(local.random()) * 4 * yield_s)
+            with harness_lock:
+                rid = rid_of.get(idx)
+            if rid is not None:
+                srv.cancel(rid)          # False when already terminal
+
+    streams = {}                         # idx -> (tokens, end_event)
+
+    def subscriber():
+        rid_ready.wait(timeout=60)
+        keeps = [r["idx"] for r in reqs if not r["victim"]][:4]
+        for idx in keeps:
+            with harness_lock:
+                rid = rid_of[idx]
+            stream = srv.token_events(rid)
+            toks, end = stream.tokens(timeout=60)
+            streams[idx] = (toks, end)
+
+    def metrics():
+        while not stop.is_set():
+            srv.health_snapshot()
+            # the /metrics surface: stats + fairness windows snapshotted
+            # under the engine lock while the scheduler mutates them
+            with srv._lock:
+                dict(srv.stats)
+                if srv._fairness is not None:
+                    srv._fairness.window_usage()
+            time.sleep(yield_s / 2)
+
+    threads = [guard("scheduler", scheduler), guard("submit-0",
+               lambda: submitter(0)), guard("submit-1",
+               lambda: submitter(1)), guard("cancel", canceller),
+               guard("subscribe", subscriber), guard("metrics", metrics)]
+    for t in threads:
+        t.start()
+    # wait until every request reached a terminal status (or a thread
+    # died); the scheduler thread keeps stepping the whole time
+    deadline = time.monotonic() + 300
+    try:
+        while time.monotonic() < deadline and not stop.is_set():
+            with harness_lock:
+                rids = dict(rid_of)
+            if len(rids) == len(reqs) and all(
+                    srv.status(rid) in TERMINAL for rid in rids.values()):
+                break
+            time.sleep(0.005)
+        else:
+            if not stop.is_set():
+                problems.append(f"seed {seed}: requests still live at "
+                                f"the 300s harness deadline")
+    finally:
+        stop.set()
+        srv.wake.set()
+        for t in threads:
+            t.join(timeout=60)
+        inject.reset_injection()
+
+    if errors:
+        problems.extend(f"seed {seed}: thread {n} died: {e}"
+                        for n, e in errors)
+    completed = cancelled = 0
+    for r in reqs:
+        idx = r["idx"]
+        rid = rid_of.get(idx)
+        if rid is None:
+            problems.append(f"seed {seed}: request {idx} never submitted")
+            continue
+        status = srv.status(rid)
+        res = srv.result(rid)
+        if status not in TERMINAL or res is None:
+            problems.append(f"seed {seed}: request {idx} (rid {rid}) "
+                            f"not terminal: {status}")
+            continue
+        if r["victim"]:
+            # cancel raced the mirror drain's retirement: either side may
+            # win, but EXACTLY one terminal status must result
+            if status not in ("CANCELLED", "COMPLETED"):
+                problems.append(f"seed {seed}: victim {idx} ended "
+                                f"{status} ({res.detail})")
+            cancelled += status == "CANCELLED"
+        else:
+            if status != "COMPLETED":
+                problems.append(f"seed {seed}: keep request {idx} ended "
+                                f"{status} ({res.detail})")
+            elif not np.array_equal(res.output, ref[idx]):
+                problems.append(
+                    f"seed {seed}: request {idx} output diverges from "
+                    f"the sequential reference (bitwise-serving "
+                    f"invariant broken)")
+            completed += 1
+    for idx, (toks, end) in streams.items():
+        rid = rid_of.get(idx)
+        res = srv.result(rid) if rid is not None else None
+        if end is None or end.get("event") != "end":
+            problems.append(f"seed {seed}: stream {idx} never ended")
+        elif res is not None and res.output is not None:
+            # the FULL generated sequence, not a prefix: the output is
+            # eos-padded to max_new, so the real sequence ends at the
+            # first eos (inclusive) — a stream that lost tail tokens
+            # before its end event must fail here
+            P = len(reqs[idx]["prompt"])
+            eos = reqs[idx]["eos"]
+            want = [int(t) for t in res.output[P:]]
+            if eos >= 0 and eos in want:
+                want = want[:want.index(eos) + 1]
+            if toks != want or end["status"] != "COMPLETED":
+                problems.append(f"seed {seed}: stream {idx} diverges "
+                                f"from the final output "
+                                f"({len(toks)} streamed vs "
+                                f"{len(want)} generated)")
+    report = {
+        "completed": completed,
+        "cancelled": cancelled,
+        "lock_wait_s": dict(srv._lock.wait_s),
+        "lock_acquires": dict(srv._lock.acquires),
+    }
+    srv.close()
+    return report, problems
+
+
+def run_interleave_check(seeds=(0, 1), n_keep=6, n_victims=3,
+                         yield_s=0.002, checks=True):
+    """Run the stress scenario once per seed; returns
+    ``{"ok", "problems", "per_seed"}``.  ``checks=True`` arms
+    ``DSTPU_CONCURRENCY_CHECKS`` for the engines built here (restoring
+    the caller's environment afterwards)."""
+    from deepspeed_tpu.inference.serving.concurrency import ENV_VAR
+    prev = os.environ.get(ENV_VAR)
+    if checks:
+        os.environ[ENV_VAR] = "1"
+    try:
+        eng = _tiny_served_engine()
+        rng = np.random.default_rng(7)
+        reqs = _workload(rng, n_keep, n_victims)
+        ref = _reference_outputs(eng, reqs)
+        per_seed, problems = {}, []
+        for seed in seeds:
+            report, probs = _run_one_seed(eng, reqs, ref, seed, yield_s)
+            per_seed[seed] = report
+            problems.extend(probs)
+        return {"ok": not problems, "problems": problems,
+                "per_seed": per_seed}
+    finally:
+        if prev is None:
+            os.environ.pop(ENV_VAR, None)
+        else:
+            os.environ[ENV_VAR] = prev
+
+
+def main():
+    result = run_interleave_check()
+    for seed, report in result["per_seed"].items():
+        waits = ", ".join(f"{k}={v:.4f}s"
+                          for k, v in report["lock_wait_s"].items())
+        print(f"[interleave] seed {seed}: {report['completed']} "
+              f"completed, {report['cancelled']} cancelled, "
+              f"lock waits {waits}")
+    for p in result["problems"]:
+        print(f"[interleave] PROBLEM: {p}")
+    verdict = ("OK — bitwise outputs, single terminal statuses, zero "
+               "guarded-field assertion trips" if result["ok"]
+               else "INTERLEAVING FAILURE — see problems above")
+    print(f"[interleave] {verdict}")
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -8,4 +8,6 @@ from deepspeed_tpu.tools.lint.rules import (  # noqa: F401
     tl005_hot_dict_lookup,
     tl006_retrace_drift,
     tl007_use_after_donation,
+    tl008_lock_discipline,
+    tl009_loop_blocking,
 )

@@ -1,0 +1,285 @@
+"""TL008 — lock-guarded field touched outside its lock's scope.
+
+The serving host path is multi-threaded (one engine lock, an owner-bound
+scheduler thread, condvar-blocked submits, asyncio handlers bridging in
+through ``run_in_executor``), and both rounds of the PR 8 post-review
+hardening were host-concurrency bugs in exactly this class: ``/metrics``
+iterating fairness state while the scheduler compacted it, a blocked
+submit binding itself as scheduler owner.  This rule makes the lock
+discipline machine-checkable the way TL006/TL007 did for the device
+programs:
+
+* **Declaring guarded state** — either a class-body dict literal::
+
+      class MiniEngine:
+          GUARDED_FIELDS = {"_queue": "_lock", "stats": "_lock"}
+
+  or a trailing comment on the field's initializing assignment::
+
+      self._mirror_active = np.zeros(n, bool)   # guarded-by: _lock
+
+  The serving engine's canonical registry lives in
+  ``inference/serving/concurrency.py`` (``GUARDED_FIELDS`` /
+  ``LOCK_ALIASES`` — pure literals this rule parses statically, never
+  imports) and is merged into every module's local declarations, so
+  cross-module accesses like the HTTP front end reading ``srv.stats``
+  are checked too.
+
+* **What counts as holding the lock** — the access sits lexically inside
+  ``with self._lock:`` (or a declared alias such as the engine's
+  ``_cond`` condvar, detected from ``self._cond =
+  threading.Condition(self._lock)``), OR the enclosing method is
+  annotated ``# lock-held: _lock`` on its ``def``/decorator line —
+  the documented caller-holds-the-lock contract (``_step_locked`` and
+  friends).  ``__init__`` is exempt: constructor state is unshared.
+
+* **Scope** — ``self.<field>`` accesses are checked inside the declaring
+  class anywhere; ``<name>.<field>`` accesses (``srv.stats``) are
+  checked in modules under the serving package or carrying a
+  ``# tpu-lint: concurrency-scope`` marker, guarded by a matching
+  ``with <name>.<lock>:``.
+
+Suppress deliberate unlocked reads with the usual escape hatch and a
+reason (``# tpu-lint: disable=TL008 -- reason``).  The runtime
+counterpart is ``DSTPU_CONCURRENCY_CHECKS=1`` + the interleaving stress
+harness (``tools/lint/interleave_check.py``) — see
+``docs/tpu_lint.md`` "Concurrency contracts".
+"""
+
+import ast
+import os
+import re
+
+from deepspeed_tpu.tools.lint.core import Finding, dotted_name, rule
+
+GUARD_COMMENT_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+LOCK_HELD_RE = re.compile(r"#\s*lock-held:\s*([A-Za-z_]\w*)")
+SCOPE_MARKER = "tpu-lint: concurrency-scope"
+
+_canonical_cache = None
+
+
+def canonical_registry():
+    """(guarded, aliases, locked_methods, owner_bound) statically parsed
+    from the serving package's ``concurrency.py`` registry — the
+    literals are read with ``ast.literal_eval``; the module is NEVER
+    imported (the linter stays import-free of the code under
+    analysis)."""
+    global _canonical_cache
+    if _canonical_cache is not None:
+        return _canonical_cache
+    here = os.path.dirname(os.path.abspath(__file__))
+    pkg = os.path.dirname(os.path.dirname(os.path.dirname(here)))
+    path = os.path.join(pkg, "inference", "serving", "concurrency.py")
+    guarded, aliases, locked, owner = {}, {}, (), ()
+    try:
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        for node in tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if not isinstance(tgt, ast.Name):
+                    continue
+                try:
+                    value = ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    continue
+                if tgt.id == "GUARDED_FIELDS":
+                    guarded = value
+                elif tgt.id == "LOCK_ALIASES":
+                    aliases = value
+                elif tgt.id == "LOCKED_METHODS":
+                    locked = tuple(value)
+                elif tgt.id == "OWNER_BOUND_METHODS":
+                    owner = tuple(value)
+    except OSError:
+        pass                             # registry absent: local-only mode
+    _canonical_cache = (guarded, aliases, locked, owner)
+    return _canonical_cache
+
+
+def _local_declarations(module):
+    """Per-module guarded declarations: {class: {field: lock}} from
+    class-body ``GUARDED_FIELDS`` dict literals and ``# guarded-by:``
+    assignment comments, plus {class: {alias: lock}} condvar aliases
+    (``self._cond = threading.Condition(self._lock)``)."""
+    declared, aliases = {}, {}
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        fields = {}
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign) \
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "GUARDED_FIELDS"
+                            for t in stmt.targets):
+                try:
+                    value = ast.literal_eval(stmt.value)
+                except (ValueError, SyntaxError):
+                    continue
+                if isinstance(value, dict):
+                    fields.update(value)
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            comment = module.lines[sub.lineno - 1] \
+                if sub.lineno - 1 < len(module.lines) else ""
+            # multi-line assignments may carry the comment on the last
+            # line of the statement instead
+            end = getattr(sub, "end_lineno", sub.lineno)
+            tail = module.lines[end - 1] if end - 1 < len(module.lines) \
+                else ""
+            m = GUARD_COMMENT_RE.search(comment) \
+                or GUARD_COMMENT_RE.search(tail)
+            for tgt in sub.targets:
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    if m:
+                        fields[tgt.attr] = m.group(1)
+                    if isinstance(sub.value, ast.Call) and \
+                            dotted_name(sub.value.func) in (
+                                "threading.Condition", "Condition") \
+                            and sub.value.args \
+                            and isinstance(sub.value.args[0],
+                                           ast.Attribute):
+                        aliases.setdefault(node.name, {})[tgt.attr] = \
+                            sub.value.args[0].attr
+        if fields:
+            declared[node.name] = fields
+    return declared, aliases
+
+
+def _acceptable_locks(lock, class_aliases):
+    """The lock attr plus every alias that resolves to it."""
+    out = {lock}
+    for alias, target in (class_aliases or {}).items():
+        if target == lock:
+            out.add(alias)
+    return out
+
+
+def _held_locks(module, fn):
+    """Lock names a ``# lock-held:`` annotation on the function header
+    declares as held by every caller."""
+    node = fn.node
+    decos = getattr(node, "decorator_list", [])
+    start = min([node.lineno] + [d.lineno for d in decos])
+    stop = node.body[0].lineno if node.body else node.lineno + 1
+    held = set()
+    # header lines only — stop BEFORE the first body statement, so a
+    # docstring that merely QUOTES the convention cannot exempt a method
+    for line_no in range(start, stop):
+        if line_no - 1 < len(module.lines):
+            m = LOCK_HELD_RE.search(module.lines[line_no - 1])
+            if m:
+                held.add(m.group(1))
+    return held
+
+
+def _own_nodes(fn_node):
+    nested = set()
+    for child in ast.walk(fn_node):
+        if child is not fn_node and isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            nested.update(n for n in ast.walk(child) if n is not child)
+    return [n for n in ast.walk(fn_node) if n not in nested]
+
+
+def _parents(root):
+    out = {}
+    for parent in ast.walk(root):
+        for child in ast.iter_child_nodes(parent):
+            out[child] = parent
+    return out
+
+
+def _with_locks_above(node, parents, fn_node):
+    """(base_dotted, lock_attr) pairs of every ``with x.y:`` item
+    lexically enclosing ``node`` within the function."""
+    out = []
+    cur = node
+    while cur in parents and cur is not fn_node:
+        cur = parents[cur]
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                ctx = item.context_expr
+                if isinstance(ctx, ast.Attribute):
+                    base = dotted_name(ctx.value)
+                    if base:
+                        out.append((base, ctx.attr))
+    return out
+
+
+@rule("TL008", "lock-guarded field accessed outside its lock")
+def check(module):
+    can_guarded, can_aliases, _locked, _owner = canonical_registry()
+    local_guarded, local_aliases = _local_declarations(module)
+    guarded = {}
+    aliases = {}
+    for src_g, src_a in ((can_guarded, can_aliases),
+                         (local_guarded, local_aliases)):
+        for cls, fields in src_g.items():
+            guarded.setdefault(cls, {}).update(fields)
+        for cls, amap in src_a.items():
+            aliases.setdefault(cls, {}).update(amap)
+    if not guarded:
+        return
+    # union for non-self checks: field -> every acceptable lock attr,
+    # plus the primary (non-alias) lock name for the finding's hint
+    field_locks, field_primary = {}, {}
+    for cls, fields in guarded.items():
+        for field, lock in fields.items():
+            field_primary.setdefault(field, lock)
+            field_locks.setdefault(field, set()).update(
+                _acceptable_locks(lock, aliases.get(cls)))
+    norm = module.path.replace(os.sep, "/")
+    nonself_scope = "serving" in norm or SCOPE_MARKER in module.text
+
+    seen = set()
+    for fn in module.functions:
+        if fn.name == "__init__":
+            continue                     # constructor state is unshared
+        held = _held_locks(module, fn)
+        own = _own_nodes(fn.node)
+        parents = _parents(fn.node)
+        cls_fields = guarded.get(fn.class_name or "", {})
+        cls_aliases = aliases.get(fn.class_name or "", {})
+        for node in own:
+            if not isinstance(node, ast.Attribute):
+                continue
+            field = node.attr
+            base = dotted_name(node.value)
+            if base is None:
+                continue
+            if base == "self":
+                if field not in cls_fields:
+                    continue
+                lock = cls_fields[field]
+                ok_locks = _acceptable_locks(lock, cls_aliases)
+                if held & ok_locks:
+                    continue
+                hint = (f"wrap in `with self.{lock}:` or annotate the "
+                        f"method `# lock-held: {lock}`")
+            else:
+                if not nonself_scope or field not in field_locks:
+                    continue
+                ok_locks = field_locks[field]
+                lock = field_primary[field]
+                hint = f"wrap in `with {base}.{lock}:`"
+            if any(b == base and attr in ok_locks
+                   for b, attr in _with_locks_above(node, parents,
+                                                    fn.node)):
+                continue
+            key = (node.lineno, base, field)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield Finding(
+                "TL008", module.path, node.lineno, node.col_offset,
+                f"{'write' if isinstance(node.ctx, ast.Store) else 'read'}"
+                f" of lock-guarded field '{base}.{field}' (guarded by "
+                f"'{lock}') outside its lock scope — {hint}; a racing "
+                f"scheduler thread mutates this state mid-access "
+                f"(docs/tpu_lint.md 'Concurrency contracts')")

@@ -1,0 +1,146 @@
+"""TL009 — engine call blocking the asyncio loop thread (or owner-bound
+call from a context that can never be the scheduler owner).
+
+The HTTP front end's event loop parses requests and serializes
+responses; the serving engine's thread-safe surface (``submit`` /
+``cancel`` / ``status`` / ``result`` / ``token_events`` / ...) takes the
+ENGINE LOCK, which the scheduler-owner thread holds across a whole
+``step()`` — a dispatch plus host-mirror bookkeeping.  A direct call
+from an ``async def`` handler therefore stalls EVERY connection for the
+duration of a scheduler iteration (and a ``queue_policy="block"`` submit
+can park the loop indefinitely).  The PR 8 hardening rounds hit exactly
+this; the fix is mechanical and this rule enforces it:
+
+* inside ``async def`` bodies (and sync callbacks registered via
+  ``call_soon_threadsafe``/``call_soon`` — the ``on_event`` bridges that
+  "must never block"), a DIRECT call to a lock-taking engine method is
+  flagged — route it through ``loop.run_in_executor(None, srv.submit,
+  ...)`` instead (a bare method REFERENCE passed to the executor is
+  fine and is the fix);
+* any appearance of an owner-bound driving method (``step`` / ``drain``
+  / ``preempt``) in those contexts is flagged outright — the loop
+  thread (and every executor worker) can never be the scheduler owner,
+  so even an executor detour just moves the runtime ``RuntimeError``.
+
+The lock-taking and owner-bound method sets come from the TL008
+registry (``inference/serving/concurrency.py``: ``LOCKED_METHODS``,
+``OWNER_BOUND_METHODS``, parsed statically) plus, per module, every
+method of a guarded-field-declaring class whose body takes ``with
+self.<lock>``.  Receivers are matched by the engine naming convention —
+the attribute chain's last base segment is ``srv``/``eng``/``engine``
+(or ``*_srv``/``*_engine``) — so ``self._server.close()`` or
+``writer.drain()`` never false-positive.  Nested ``def``/``lambda``
+bodies are exempt: they are the executor thunks.
+
+Suppress a deliberate loop-thread call with
+``# tpu-lint: disable=TL009 -- reason``.
+"""
+
+import ast
+
+from deepspeed_tpu.tools.lint.core import Finding, dotted_name, rule
+from deepspeed_tpu.tools.lint.rules.tl008_lock_discipline import (
+    _local_declarations, _own_nodes, canonical_registry)
+
+_ENGINE_SEGMENTS = ("srv", "eng", "engine")
+
+
+def _engine_receiver(value):
+    """True when the attribute base names an engine by convention."""
+    base = dotted_name(value)
+    if not base:
+        return False
+    seg = base.split(".")[-1]
+    return seg in _ENGINE_SEGMENTS or seg.endswith("_srv") \
+        or seg.endswith("_engine") or seg.lstrip("_") in _ENGINE_SEGMENTS
+
+
+def _module_locked_methods(module):
+    """Methods of locally-declared guarded classes whose bodies take
+    ``with self.<lock>`` — the module's own thread-safe surface."""
+    declared, aliases = _local_declarations(module)
+    out = set()
+    for fn in module.functions:
+        cls = fn.class_name
+        if cls not in declared:
+            continue
+        locks = set(declared[cls].values()) | set(aliases.get(cls, {}))
+        for node in ast.walk(fn.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Attribute) \
+                            and isinstance(ctx.value, ast.Name) \
+                            and ctx.value.id == "self" \
+                            and ctx.attr in locks:
+                        out.add(fn.name)
+    return out
+
+
+def _callback_names(module):
+    """Sync functions handed to ``call_soon_threadsafe``/``call_soon`` —
+    they run ON the loop thread and must never block."""
+    out = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute) \
+                and node.func.attr in ("call_soon_threadsafe",
+                                       "call_soon") and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Name):
+                out.add(target.id)
+    return out
+
+
+@rule("TL009", "engine call blocking the asyncio loop thread")
+def check(module):
+    _g, _a, locked, owner_bound = canonical_registry()
+    locked = set(locked) | _module_locked_methods(module)
+    owner_bound = set(owner_bound)
+    if not locked and not owner_bound:
+        return
+    callbacks = _callback_names(module)
+    for fn in module.functions:
+        is_async = isinstance(fn.node, ast.AsyncFunctionDef)
+        is_callback = fn.name in callbacks \
+            and isinstance(fn.node, ast.FunctionDef)
+        if not (is_async or is_callback):
+            continue
+        ctx_name = "async handler" if is_async else \
+            "loop callback (registered via call_soon*)"
+        own = _own_nodes(fn.node)
+        parents = {}
+        for parent in own:
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        seen = set()
+        for node in own:
+            if not isinstance(node, ast.Attribute) \
+                    or not _engine_receiver(node.value):
+                continue
+            parent = parents.get(node)
+            is_direct_call = isinstance(parent, ast.Call) \
+                and parent.func is node
+            key = (node.lineno, node.attr)
+            if key in seen:
+                continue
+            if node.attr in owner_bound:
+                seen.add(key)
+                yield Finding(
+                    "TL009", module.path, node.lineno, node.col_offset,
+                    f"owner-bound '{dotted_name(node) or node.attr}' in "
+                    f"{ctx_name} '{fn.name}' — only the scheduler-owner "
+                    f"thread may drive step()/drain()/preempt(); an "
+                    f"executor detour still raises at runtime.  Signal "
+                    f"the scheduler thread instead (srv.wake / a flag "
+                    f"the owner polls)")
+            elif node.attr in locked and is_direct_call:
+                seen.add(key)
+                yield Finding(
+                    "TL009", module.path, node.lineno, node.col_offset,
+                    f"direct call to lock-taking "
+                    f"'{dotted_name(node) or node.attr}()' in {ctx_name} "
+                    f"'{fn.name}' blocks the event loop for up to a full "
+                    f"scheduler step — route it through "
+                    f"`await loop.run_in_executor(None, "
+                    f"{dotted_name(node) or node.attr}, ...)`")

@@ -23,7 +23,7 @@ def lint_fixture(name, rules=None):
 
 @pytest.mark.parametrize("rule_id,expected_min", [
     ("TL001", 7), ("TL002", 3), ("TL003", 4), ("TL004", 2), ("TL005", 2),
-    ("TL006", 9), ("TL007", 4)])
+    ("TL006", 9), ("TL007", 4), ("TL008", 6), ("TL009", 5)])
 def test_rule_positive_fixture(rule_id, expected_min):
     findings, _ = lint_fixture(f"{rule_id.lower()}_positive.py")
     hits = [f for f in findings if f.rule == rule_id]
@@ -33,7 +33,7 @@ def test_rule_positive_fixture(rule_id, expected_min):
 
 @pytest.mark.parametrize("rule_id",
                          ["TL001", "TL002", "TL003", "TL004", "TL005",
-                          "TL006", "TL007"])
+                          "TL006", "TL007", "TL008", "TL009"])
 def test_rule_negative_fixture(rule_id):
     findings, _ = lint_fixture(f"{rule_id.lower()}_negative.py")
     hits = [f for f in findings if f.rule == rule_id]
@@ -68,12 +68,33 @@ def test_cli_list_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("TL001", "TL002", "TL003", "TL004", "TL005", "TL006",
-                "TL007"):
+                "TL007", "TL008", "TL009"):
         assert rid in out
 
 
 def test_cli_update_requires_contracts(capsys):
     assert lint_main(["--update"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_concurrency_exits_nonzero_on_unlocked_access(capsys):
+    """`ds_lint --concurrency` on a synthetically introduced unlocked
+    guarded-field access must exit nonzero from the STATIC sweep (the
+    slow interleaving prover is skipped once the sweep is dirty)."""
+    assert lint_main(["--concurrency",
+                      str(FIXTURES / "tl008_positive.py")]) == 1
+    out = capsys.readouterr().out
+    assert "TL008" in out and "tpu-lint[concurrency]" in out
+
+
+def test_cli_concurrency_clean_paths_reach_the_prover(capsys, monkeypatch):
+    """With a clean sweep, --concurrency hands off to the interleaving
+    harness (stubbed here — the real harness runs as its own tier-1
+    test in test_serving_concurrency.py)."""
+    from deepspeed_tpu.tools.lint import interleave_check
+    monkeypatch.setattr(interleave_check, "main", lambda: 0)
+    assert lint_main(["--concurrency",
+                      str(FIXTURES / "tl008_negative.py")]) == 0
     capsys.readouterr()
 
 

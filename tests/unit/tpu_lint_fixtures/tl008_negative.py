@@ -1,0 +1,44 @@
+"""TL008 negative fixture — every guarded access is lock-correct.
+Expect ZERO findings.
+# tpu-lint: concurrency-scope
+"""
+import threading
+
+
+class MiniEngine:
+    GUARDED_FIELDS = {"_queue": "_lock", "stats": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._queue = []
+        self.stats = {"n": 0}
+        self._mirror = {}                # guarded-by: _lock
+        self.config = {"depth": 4}       # undeclared: not checked
+
+    def submit(self, x):
+        with self._lock:
+            self._queue.append(x)
+            self.stats["n"] += 1
+
+    def _drain_locked(self):             # lock-held: _lock
+        while self._queue:
+            self._queue.pop()
+        self._mirror.clear()
+
+    def blocked_submit(self, x):
+        with self._cond:                 # condvar alias of _lock
+            self._queue.append(x)
+            self._cond.notify_all()
+
+    def free_reads(self):
+        return self.config["depth"]      # undeclared field: fine
+
+
+def metrics(srv):
+    with srv._lock:
+        return dict(srv.stats)           # locked non-self access
+
+
+def unrelated(obj):
+    return obj.config                    # not a guarded field

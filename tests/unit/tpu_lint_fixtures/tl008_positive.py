@@ -1,0 +1,40 @@
+"""TL008 positive fixture — lock-guarded fields touched outside their
+lock.  Expect >= 6 findings.  The module opts into non-self checks:
+# tpu-lint: concurrency-scope
+"""
+import threading
+
+
+class MiniEngine:
+    GUARDED_FIELDS = {"_queue": "_lock", "stats": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._queue = []                 # __init__ writes are exempt
+        self.stats = {"n": 0}
+        self._mirror = {}                # guarded-by: _lock
+
+    def submit(self, x):
+        with self._lock:
+            self._queue.append(x)
+        self.stats["n"] += 1             # FINDING: after the with block
+
+    def peek(self):
+        return len(self._queue)          # FINDING: no lock at all
+
+    def drain_helper(self):              # no caller-holds annotation
+        self._mirror.clear()             # FINDING: comment-declared field
+
+    def racy_branch(self):
+        if self._queue:                  # FINDING: read
+            self._mirror["x"] = 1        # FINDING: write (distinct field)
+
+    def suppressed_monitor(self):
+        # a reasoned escape hatch still counts as suppressed, not found
+        return len(self._queue)  # tpu-lint: disable=TL008 -- fixture: benign racy monitor read
+
+
+def metrics(srv):
+    # non-self access to a canonical ServingEngine guarded field
+    return dict(srv.stats)               # FINDING: no `with srv._lock`

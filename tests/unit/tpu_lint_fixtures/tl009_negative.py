@@ -1,0 +1,38 @@
+"""TL009 negative fixture — the mechanical fixes and non-engine
+receivers.  Expect ZERO findings."""
+import asyncio  # noqa: F401
+
+
+async def handler(loop, srv, spec):
+    # the fix: a bare method REFERENCE handed to the executor
+    rid = await loop.run_in_executor(None, srv.submit, spec)
+    await loop.run_in_executor(None, srv.token_events, rid, print)
+    return rid
+
+
+async def cancel_route(loop, srv, rid):
+    def _cancel():                       # executor thunk: exempt
+        try:
+            srv.cancel(rid)
+        except KeyError:
+            pass
+    await loop.run_in_executor(None, _cancel)
+
+
+async def close_listener(self_server):
+    # receiver is not an engine by the naming convention
+    self_server.close()
+
+
+async def drain_writer(writer):
+    await writer.drain()                 # asyncio writer, not the engine
+
+
+def scheduler_loop(srv):
+    # a plain sync function IS the scheduler-owner thread's body
+    while srv.queue_depth:
+        srv.step()
+
+
+def on_event(loop, ev):
+    loop.call_soon_threadsafe(print, ev)

@@ -1,0 +1,51 @@
+"""TL009 positive fixture — engine calls that block the asyncio loop
+thread (or owner-bound calls that can never succeed there).  Expect
+>= 5 findings."""
+import asyncio  # noqa: F401
+
+
+async def handler(srv, spec):
+    rid = srv.submit(spec)               # FINDING: blocks the loop
+    return rid
+
+
+async def poll(srv, rid):
+    return srv.status(rid)               # FINDING: blocks the loop
+
+
+async def drive(srv):
+    srv.step()                           # FINDING: owner-bound
+
+
+async def sneaky(loop, srv):
+    # even through the executor, drain() runs on a worker thread that
+    # can never be the scheduler owner — it raises at runtime
+    await loop.run_in_executor(None, srv.drain)   # FINDING: owner-bound
+
+
+def wire(loop, srv):
+    loop.call_soon_threadsafe(bad_callback, srv)
+
+
+def bad_callback(srv):
+    # registered on the loop via call_soon_threadsafe above: runs ON the
+    # loop thread, so a lock-taking call stalls every connection
+    srv.cancel(3)                        # FINDING: callback blocks loop
+
+
+class LocalServer:
+    GUARDED_FIELDS = {"_queue": "_lock"}
+
+    def __init__(self):
+        import threading
+        self._lock = threading.RLock()
+        self._queue = []
+
+    def enqueue(self, x):
+        with self._lock:
+            self._queue.append(x)
+
+
+async def local_handler(srv, x):
+    # the module-local class's lock-taking method is derived, not listed
+    srv.enqueue(x)                       # FINDING: module-derived method

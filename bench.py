@@ -939,6 +939,143 @@ def serving_paged_bench(model_name="opt-1.3b", *, slots_list=(96, 128, 192),
     }
 
 
+def serving_spec_bench(model_name="opt-1.3b", *, slots_list=(4, 8, 16),
+                       k_list=(2, 4, 8), decode_block=8,
+                       prefill_chunk=128):
+    """Speculative multi-token serving (``docs/serving.md`` "Speculative
+    decoding") at the latency-sensitive bs<=16 points where BENCH_r02/r04
+    show decode stuck near ~1.2k tok/s/chip: per (num_slots, spec_k)
+    point, a SELF-draft speculative server (the target model drafts for
+    itself — accept rate ~1.0 under greedy, so the measurement isolates
+    the dispatch-amortization/batched-verify ceiling; a trained small
+    draft trades accept rate against draft cost) against the
+    non-speculative serving baseline at the same concurrency.  Records
+    the accept rate, committed tokens per dispatch, decode tok/s/chip
+    and speedup vs non-spec, time-between-tokens p50/p99 from the
+    per-token event streams, and the executables-per-server proof
+    (exactly one draft-propose + one verify-and-commit signature)."""
+    import jax
+    from deepspeed_tpu.models.opt import opt_config
+    from deepspeed_tpu.models.transformer import Transformer
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+
+    cache_len = 384                         # prompts <= 256, new <= 128
+    cfg = opt_config(model_name, max_seq_len=cache_len, dtype="bfloat16",
+                     scan_layers=False)
+    model = Transformer(cfg)
+    eng = InferenceEngine(model, DeepSpeedInferenceConfig(
+        dtype="bfloat16", compile_cache=_cc_block(),
+        serving={"enabled": True, "max_cache_len": cache_len,
+                 "prefill_chunk": prefill_chunk,
+                 "prefill_token_budget": 256,
+                 "decode_block": decode_block}))
+    eng.init_params()
+    rng = np.random.default_rng(0)
+    n_dev = jax.device_count()
+    max_k = max(k_list)
+
+    def workload(bs):
+        n_requests = max(2 * bs, 12)        # slots churn at least once
+        prompt_lens = rng.choice([64, 96, 128, 192], n_requests)
+        new_lens = rng.choice([64, 96, 128], n_requests)
+        prompts = [rng.integers(0, cfg.vocab_size, (int(p),))
+                   .astype(np.int32)
+                   # leave room for the spec window reserve at every k
+                   if p + 128 + max_k - 1 <= cache_len else
+                   rng.integers(0, cfg.vocab_size, (64,)).astype(np.int32)
+                   for p in prompt_lens]
+        return prompts, [int(n) for n in new_lens]
+
+    def run(srv, prompts, new_lens):
+        """Drain the workload; returns (dt, tbt_ms list) — time between
+        consecutive token events per request, wall clock at the
+        host-mirror drain point (the stream's tick)."""
+        stamps = {}
+
+        def on_event_for(rid):
+            def on_event(ev, _rid=rid):
+                if ev.get("event") == "token":
+                    stamps.setdefault(_rid, []).append(time.perf_counter())
+            return on_event
+
+        t0 = time.perf_counter()
+        rids = [srv.submit(p, max_new_tokens=n)
+                for p, n in zip(prompts, new_lens)]
+        for rid in rids:
+            srv.token_events(rid, on_event=on_event_for(rid))
+        srv.drain()
+        dt = time.perf_counter() - t0
+        tbt = []
+        for ts in stamps.values():
+            tbt.extend((b - a) * 1e3 for a, b in zip(ts, ts[1:]))
+        return dt, tbt
+
+    def pct(xs, q):
+        return round(float(np.percentile(xs, q)), 2) if xs else None
+
+    points, baselines = [], []
+    for bs in slots_list:
+        prompts, new_lens = workload(bs)
+        useful = int(np.sum(new_lens))
+        base = eng.serve(num_slots=bs)
+        base.warmup()
+        run(base, prompts, new_lens)        # compile + warm
+        dt_base, tbt_base = run(base, prompts, new_lens)
+        base.close()
+        base_tps = useful / dt_base / n_dev
+        baselines.append({
+            "num_slots": bs, "n_requests": len(prompts),
+            "tokens_per_sec_chip": round(base_tps, 1),
+            "time_between_tokens_p50_ms": pct(tbt_base, 50),
+            "time_between_tokens_p99_ms": pct(tbt_base, 99),
+            "time_s": round(dt_base, 3),
+        })
+        for k in k_list:
+            srv = eng.serve(num_slots=bs, speculative=True, spec_k=k,
+                            spec_draft_model="self")
+            srv.warmup()
+            run(srv, prompts, new_lens)     # compile + warm
+            dt, tbt = run(srv, prompts, new_lens)
+            tps = useful / dt / n_dev
+            points.append({
+                "num_slots": bs, "spec_k": k,
+                "accept_rate": round(srv.stats["spec_accept_rate"], 3),
+                "tokens_per_dispatch":
+                    round(srv.stats["spec_tokens_per_dispatch"], 2),
+                "draft_time_fraction":
+                    round(srv.stats["spec_draft_fraction"], 3),
+                "tokens_per_sec_chip": round(tps, 1),
+                "speedup_vs_nonspec": round(tps / base_tps, 3),
+                "time_between_tokens_p50_ms": pct(tbt, 50),
+                "time_between_tokens_p99_ms": pct(tbt, 99),
+                "time_s": round(dt, 3),
+                # the one-executable-per-program proof, per server
+                "propose_executables": sum(
+                    1 for sig in eng._aot
+                    if sig and sig[0] == id(srv._propose_fn)),
+                "verify_executables": sum(
+                    1 for sig in eng._aot
+                    if sig and sig[0] == id(srv._verify_fn)),
+            })
+            srv.close()
+    best = max(points, key=lambda p: p.get("speedup_vs_nonspec") or 0.0) \
+        if points else None
+    return {
+        "model": model_name,
+        "draft": "self (accept-rate ceiling; trained small drafts trade "
+                 "accept rate against draft cost)",
+        "decode_block_baseline": decode_block,
+        "points": points,
+        "baselines": baselines,
+        "best_speedup_vs_nonspec":
+            best["speedup_vs_nonspec"] if best else None,
+        "best_point": {"num_slots": best["num_slots"],
+                       "spec_k": best["spec_k"]} if best else None,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def long_context_bench(model_name="opt-1.3b", *, seq=8192, micro_bs=1,
                        steps=4):
     """Long-context SFT through the Pallas flash-attention path (the
@@ -1283,6 +1420,17 @@ PHASES = [
                                     slots_list=(48, 64) if fb
                                     else (96, 128, 192),
                                     prefix_requests=12 if fb else 24)),
+    # speculative decoding at the latency-sensitive bs<=16 end (ROADMAP
+    # item 3): self-draft accept-rate ceiling per (bs, k) point vs the
+    # non-spec serving baseline — accept rate, tok/s/chip, TBT p50/p99,
+    # and the one-propose/one-verify executables-per-server proof.
+    # After serving_paged: each (bs, k) point compiles a fresh
+    # propose+verify pair (serving programs bypass the persistent
+    # caches), so the grid is the compile cost (see PHASE_TIMEOUT_SCALE)
+    ("serving_speculative", "serving_spec",
+     lambda fb: serving_spec_bench("opt-1.3b",
+                                   slots_list=(4,) if fb else (4, 8, 16),
+                                   k_list=(2, 4) if fb else (2, 4, 8))),
     ("generation_int8", "decode_int8",
      lambda fb: decode_bench("opt-1.3b", int8=True,
                              batch_size=8 if fb else 16)),
@@ -1344,6 +1492,10 @@ PHASE_TIMEOUT_SCALE = {
     # prefix server's — all opted out of the persistent caches (the PR 5
     # reload-corruption class), so every run compiles them cold
     "serving_paged": 2.0,
+    # one propose + one verify program per (bs, k) grid point, all
+    # persistent-cache-opted-out like every serving program: the 3x3
+    # grid compiles 18 programs cold plus 3 non-spec baselines
+    "serving_spec": 3.0,
     "offload": 1.5,
 }
 
@@ -1448,7 +1600,8 @@ def _phase_order(phases):
 def _regression_direction(key):
     """+1 = higher is better, -1 = lower is better, 0 = not a perf metric."""
     if "tokens_per_sec" in key or "tok_s" in key or key == "mfu" \
-            or key.startswith("speedup") or key.endswith("_efficiency"):
+            or key.startswith("speedup") or key.endswith("_efficiency") \
+            or "accept_rate" in key or key == "tokens_per_dispatch":
         return 1
     if key in ("step_time_s", "e2e_time_s") or "ttft_" in key \
             or "time_between_tokens" in key or key.startswith("lock_wait_"):

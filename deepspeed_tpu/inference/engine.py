@@ -504,7 +504,8 @@ class InferenceEngine:
         ``release_workspace``, ``inference_context.h``)."""
         self._workspace.release()
 
-    def serve(self, monitor=None, **overrides):
+    def serve(self, monitor=None, draft_module=None, draft_params=None,
+              **overrides):
         """A continuous-batching :class:`~deepspeed_tpu.inference.serving.
         ServingEngine` over this engine (``docs/serving.md``): slot-based
         in-flight batching — ``submit()`` requests, ``drain()`` results;
@@ -514,9 +515,15 @@ class InferenceEngine:
         (``engine.serve(num_slots=16)``); ``serving.paged=True`` swaps
         the per-slot monolithic KV lanes for a block-table page pool
         with copy-on-write prefix sharing (``engine.serve(paged=True,
-        page_size=64)``)."""
+        page_size=64)``); ``serving.speculative=True`` turns on
+        draft-assisted speculative decoding — pass the draft model as
+        ``engine.serve(speculative=True, draft_module=...,
+        draft_params=...)`` or set ``serving.spec_draft_model``
+        (``docs/serving.md`` "Speculative decoding")."""
         from deepspeed_tpu.inference.serving.engine import ServingEngine
-        return ServingEngine(self, monitor=monitor, **overrides)
+        return ServingEngine(self, monitor=monitor,
+                             draft_module=draft_module,
+                             draft_params=draft_params, **overrides)
 
     def _run_guarded(self, fn, args):
         """Compile-and-check-then-execute: the generation program is

@@ -67,6 +67,12 @@ GUARDED_FIELDS = {
         "_page_table": "_lock",
         "_pool": "_lock",
         "_prefix": "_lock",
+        # speculative-decoding draft mirror (the draft KV workspace
+        # handle chains dispatch-to-dispatch like _cache/_state; the
+        # draft lane pool hands out admission prefill lanes one event
+        # behind, like _lane_pool's donated-liveness contract)
+        "_draft_cache": "_lock",
+        "_draft_lanes": "_lock",
         # results / lifecycle
         "_results": "_lock",
         "_pending_reports": "_lock",

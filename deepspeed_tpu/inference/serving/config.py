@@ -61,6 +61,31 @@ class ServingConfig(DeepSpeedConfigModel):
     # most one page.  Unreferenced prefix pages evict LRU under pool
     # pressure
     prefix_cache: bool = True
+    # ---- speculative decoding (docs/serving.md "Speculative
+    # decoding") ----
+    # speculative=True: a small DRAFT model proposes spec_k tokens per
+    # live slot per dispatch and the target model verifies all of them
+    # in ONE batched forward — up to spec_k+1 tokens committed per
+    # target forward, greedy outputs bitwise-identical to
+    # non-speculative serving.  Requires a draft model
+    # (engine.serve(draft_module=..., draft_params=...) or
+    # spec_draft_model="self") and greedy decoding (do_sample=False).
+    # Supersedes decode_block (the verify window is the block).  Default
+    # off = seed behavior.
+    speculative: bool = False
+    # draft tokens proposed per verify window; each window commits
+    # between 1 and spec_k+1 tokens.  Each slot lane reserves spec_k-1
+    # extra tail positions for the window's writes, so requests must
+    # satisfy prompt + max_new_tokens + spec_k - 1 <= max_cache_len
+    spec_k: int = 4
+    # draft model source when serve() is not handed one explicitly:
+    # "self" = the target model drafts for itself (accept rate 1.0 under
+    # greedy — the dispatch/batched-verify ceiling; doubles KV + decode
+    # compute), or an OPT preset name ("opt-125m") built against the
+    # target's vocab — pass its trained weights via
+    # serve(draft_params=...), else they are RANDOMLY initialized
+    # (accept rate ~0; smoke/bench floor only, warned loudly)
+    spec_draft_model: str = ""
     # sampling applied to every request (greedy when do_sample=False);
     # per-request eos_token_id/max_new_tokens ride the slot state instead
     do_sample: bool = False

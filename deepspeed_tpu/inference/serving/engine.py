@@ -54,6 +54,23 @@ lanes, roughly doubling page capacity.  Still exactly ONE decode
 executable per server lifetime: page churn only changes table
 CONTENTS, never a program shape.
 
+**Speculative decoding** (``serving.speculative``, ``docs/serving.md``
+"Speculative decoding"): a small DRAFT model proposes ``spec_k`` greedy
+tokens per live slot from its own (always monolithic) KV workspace, and
+the target model verifies the whole window in ONE batched forward —
+accept mask, per-slot accepted length, eos/budget truncation and the
+state update all computed IN-PROGRAM, the draft tokens flowing
+propose → verify as a device array.  Up to ``spec_k + 1`` tokens commit
+per target dispatch; every committed token is the target's own
+``build_sample_fn`` output over exactly the committed history, so
+greedy speculative serving is BITWISE-identical to the plain decode
+path.  Fixed ``spec_k`` keeps the one-executable discipline: exactly
+one draft-propose and one verify-and-commit executable per server
+lifetime.  Admission streams each prompt chunk through BOTH models
+(the draft lane rides the admit event one-behind like the target
+lane); preemption snapshots committed tokens only, and restore
+re-derives all draft state through the ordinary re-prefill path.
+
 **Robustness / SLO layer** (``docs/serving.md`` "Robustness & SLOs"):
 every request ends in a typed terminal status (``COMPLETED`` |
 ``SHED_DEADLINE`` | ``CANCELLED`` | ``ABORTED``); per-request wall-clock
@@ -99,9 +116,14 @@ from deepspeed_tpu.inference.serving.slo import (CircuitBreaker,
 from deepspeed_tpu.inference.serving.slots import (init_slot_state,
                                                    make_admit_fn,
                                                    make_decode_block_fn,
+                                                   make_draft_admit_fn,
+                                                   make_draft_chunk_fn,
+                                                   make_draft_propose_fn,
                                                    make_paged_admit_fn,
                                                    make_paged_chunk_fn,
-                                                   make_paged_decode_block_fn)
+                                                   make_paged_decode_block_fn,
+                                                   make_paged_spec_verify_fn,
+                                                   make_spec_verify_fn)
 from deepspeed_tpu.runtime.fault import inject
 from deepspeed_tpu.utils.logging import log_dist, logger
 
@@ -162,6 +184,9 @@ class _PendingPrefill:
         # (page-aligned); positions < start are served by shared pages
         self.start = 0
         self.fill_tokens = None          # full fill (prefix registration)
+        # speculative serving: the DRAFT model's single-lane prefill
+        # cache (the prompt's K/V must land in the draft cache too)
+        self.draft_lane = None
 
 
 class _LanePool:
@@ -208,7 +233,8 @@ class ServingEngine:
     # DSTPU_CONCURRENCY_CHECKS=1 asserts the lock is held at runtime
     # (__init__ tail below).
 
-    def __init__(self, engine, monitor=None, **overrides):
+    def __init__(self, engine, monitor=None, draft_module=None,
+                 draft_params=None, **overrides):
         assert engine.params is not None, \
             "no parameters: set_params/init_params first"
         cfg = getattr(engine._config, "serving", None) or ServingConfig()
@@ -277,6 +303,34 @@ class ServingEngine:
                 raise ValueError(f"serving.num_pages={cfg.num_pages}: "
                                  f"need >= 2 (trash + 1 allocatable)")
 
+        # ---- speculative decoding (docs/serving.md "Speculative
+        # decoding"): draft model + the fixed verify window ----
+        self.speculative = bool(cfg.speculative)
+        self.spec_k = int(cfg.spec_k)
+        if self.speculative:
+            if cfg.do_sample:
+                raise ValueError(
+                    "serving.speculative=True requires greedy decoding "
+                    "(do_sample=False): the verify-and-commit program's "
+                    "bitwise contract is the target's greedy tokens — "
+                    "lossless speculative SAMPLING is not implemented")
+            if not 1 <= self.spec_k <= 64:
+                raise ValueError(f"serving.spec_k={cfg.spec_k}: need "
+                                 f"1 <= spec_k <= 64")
+            draft_module, draft_params = self._resolve_draft(
+                engine, cfg, draft_module, draft_params)
+            self.draft_module = draft_module
+            dvocab = getattr(getattr(draft_module, "config", None),
+                             "vocab_size", None)
+            tvocab = getattr(getattr(self.module, "config", None),
+                             "vocab_size", None)
+            if dvocab is not None and tvocab is not None \
+                    and dvocab != tvocab:
+                raise ValueError(
+                    f"draft model vocab_size={dvocab} != target "
+                    f"vocab_size={tvocab} — speculative verification "
+                    f"compares token ids, the vocabularies must match")
+
         from deepspeed_tpu.inference.engine import (KVCacheWorkspace,
                                                     build_sample_fn)
         sample_fn = build_sample_fn(bool(cfg.do_sample),
@@ -284,34 +338,75 @@ class ServingEngine:
                                     int(cfg.top_k), float(cfg.top_p))
         sampling_key = (bool(cfg.do_sample), float(cfg.temperature),
                         int(cfg.top_k), float(cfg.top_p))
+        self._decode_fn = self._propose_fn = self._verify_fn = None
+        self._draft_chunk_fn = self._draft_admit_fn = None
         if self.paged:
             # paged programs: the pool + per-slot page tables replace the
             # monolithic slot lanes.  Page tables are traced arguments
             # (rebuilt host-side per dispatch), so page churn/sharing
             # never mints a new executable — still exactly ONE decode
             # signature per server lifetime.
-            self._decode_fn = make_paged_decode_block_fn(
-                self.module, sample_fn, engine._deq, self.block,
-                self.cache_len)
+            if self.speculative:
+                self._verify_fn = make_paged_spec_verify_fn(
+                    self.module, sample_fn, engine._deq, self.spec_k,
+                    self.cache_len)
+                engine._tags[id(self._verify_fn)] = (
+                    "serving_spec_verify_paged", self.num_slots,
+                    self.num_pages, self.page, self.spec_k, sampling_key)
+            else:
+                self._decode_fn = make_paged_decode_block_fn(
+                    self.module, sample_fn, engine._deq, self.block,
+                    self.cache_len)
+                engine._tags[id(self._decode_fn)] = (
+                    "serving_decode_paged", self.num_slots,
+                    self.num_pages, self.page, self.block, sampling_key)
             self._admit_fn = make_paged_admit_fn(sample_fn)
-            engine._tags[id(self._decode_fn)] = (
-                "serving_decode_paged", self.num_slots, self.num_pages,
-                self.page, self.block, sampling_key)
             engine._tags[id(self._admit_fn)] = (
                 "serving_admit_paged", self.num_slots, sampling_key)
         else:
-            self._decode_fn = make_decode_block_fn(
-                self.module, sample_fn, engine._deq, self.block,
-                self.cache_len)
+            if self.speculative:
+                self._verify_fn = make_spec_verify_fn(
+                    self.module, sample_fn, engine._deq, self.spec_k,
+                    self.cache_len)
+                engine._tags[id(self._verify_fn)] = (
+                    "serving_spec_verify", self.num_slots,
+                    self.cache_len, self.spec_k, sampling_key)
+            else:
+                self._decode_fn = make_decode_block_fn(
+                    self.module, sample_fn, engine._deq, self.block,
+                    self.cache_len)
+                # stable program tags → the engine's AOT path
+                # persists/reloads these executables through the
+                # compile_cache store
+                engine._tags[id(self._decode_fn)] = (
+                    "serving_decode", self.num_slots, self.cache_len,
+                    self.block, sampling_key)
             self._admit_fn = make_admit_fn(sample_fn)
-            # stable program tags → the engine's AOT path persists/reloads
-            # these executables through the compile_cache store
-            engine._tags[id(self._decode_fn)] = (
-                "serving_decode", self.num_slots, self.cache_len,
-                self.block, sampling_key)
             engine._tags[id(self._admit_fn)] = (
                 "serving_admit", self.num_slots, self.cache_len,
                 sampling_key)
+        if self.speculative:
+            # the draft side: one propose program, one draft prefill
+            # chunk, one draft lane insert — the draft KV cache is
+            # ALWAYS monolithic lanes [L_d, num_slots, cache_len, ...]
+            # (the draft model is small; paging its cache would buy
+            # little and complicate the pool bookkeeping for nothing)
+            self._draft_deq = engine._deq \
+                if draft_module is self.module else None
+            self._propose_fn = make_draft_propose_fn(
+                draft_module, self._draft_deq, self.spec_k,
+                self.cache_len)
+            self._draft_chunk_fn = make_draft_chunk_fn(draft_module,
+                                                       self._draft_deq)
+            self._draft_admit_fn = make_draft_admit_fn()
+            engine._tags[id(self._propose_fn)] = (
+                "serving_spec_propose", self.num_slots, self.cache_len,
+                self.spec_k)
+            engine._tags[id(self._draft_chunk_fn)] = (
+                "serving_spec_draft_prefill", self.chunk)
+            engine._tags[id(self._draft_admit_fn)] = (
+                "serving_spec_draft_admit", self.num_slots,
+                self.cache_len)
         # The serving programs must NOT be reloaded from either
         # persistent cache layer (serialized-executable store OR the XLA
         # disk cache): they chain one donated slot workspace across three
@@ -344,11 +439,19 @@ class ServingEngine:
             self._chunk_fn = engine._make_chunk_fn()
             engine._tags[id(self._chunk_fn)] = ("serving_prefill",
                                                 self.chunk)
-        for fn in (self._decode_fn, self._admit_fn, self._chunk_fn):
-            engine._persist_opt_out.add(id(fn))
+        for fn in (self._decode_fn, self._admit_fn, self._chunk_fn,
+                   self._verify_fn, self._propose_fn,
+                   self._draft_chunk_fn, self._draft_admit_fn):
+            if fn is not None:
+                engine._persist_opt_out.add(id(fn))
 
         self._cache_ws = KVCacheWorkspace(self.module)
         self._lane_pool = _LanePool(self.module)
+        if self.speculative:
+            self._draft_params = draft_params
+            self._draft_ws = KVCacheWorkspace(self.draft_module)
+            self._draft_lanes = _LanePool(self.draft_module)  # guarded-by: _lock
+            self._draft_cache = None                          # guarded-by: _lock
         if self.paged:
             self._pool_ws = PagedPoolWorkspace(self.module)
             self._pool = PagePool(self.num_pages)   # guarded-by: _lock
@@ -421,6 +524,21 @@ class ServingEngine:
                       "stream_bridge_drops": 0,
                       "lock_wait_scheduler_s": 0.0,
                       "lock_wait_handler_s": 0.0}
+        if self.speculative:
+            # speculative-decoding observability (docs/serving.md
+            # "Speculative decoding"): windows = (dispatch x live slot)
+            # verify opportunities, each committing 1..spec_k+1 tokens;
+            # accept_rate = accepted draft tokens / proposed draft
+            # tokens; draft/verify secs are host dispatch wall time.
+            # Every key is exported as a dstpu_serving_spec_* gauge by
+            # /metrics (the stats sweep) and as Serving/spec_* monitor
+            # events (_emit_metrics).
+            self.stats.update({
+                "spec_rounds": 0, "spec_windows": 0,
+                "spec_committed_tokens": 0, "spec_accept_rate": 0.0,
+                "spec_tokens_per_dispatch": 0.0,
+                "spec_draft_secs": 0.0, "spec_verify_secs": 0.0,
+                "spec_draft_fraction": 0.0})
         self.occupancy_trace = []        # (it, n_active)  # guarded-by: _lock
         # classify lock waiters as scheduler vs handler; the ref is read
         # AFTER a successful acquire, i.e. lock-held (concurrency.py)
@@ -432,6 +550,73 @@ class ServingEngine:
             # interleaving stress harness drives serving traffic with
             # this armed (tools/lint/interleave_check.py)
             install_concurrency_checks(self)
+
+    @staticmethod
+    def _resolve_draft(engine, cfg, draft_module, draft_params):
+        """The draft model behind ``serving.speculative``: an explicitly
+        passed ``(draft_module, draft_params)`` pair wins;
+        ``spec_draft_model="self"`` drafts with the target model itself
+        (accept rate 1.0 under greedy — the dispatch/batched-verify
+        ceiling, at the cost of a second full-size KV cache and a
+        doubled decode forward); an OPT preset name builds the
+        architecture against the target's vocab and uses the given
+        ``draft_params`` — or RANDOM weights with a loud warning
+        (accept rate ~0; smoke/bench floor only).  Float draft params
+        are cast to the engine's compute dtype like ``set_params``
+        does."""
+        if draft_module is None:
+            name = (cfg.spec_draft_model or "").strip()
+            if name == "self":
+                if draft_params is not None:
+                    raise ValueError(
+                        "spec_draft_model='self' drafts with the TARGET "
+                        "model's own weights, but draft_params was also "
+                        "passed — silently ignoring them would run the "
+                        "wrong draft; pass draft_module with those "
+                        "params, or drop one of the two")
+                return engine.module, engine._params
+            if not name:
+                raise ValueError(
+                    "serving.speculative=True needs a draft model: pass "
+                    "engine.serve(draft_module=..., draft_params=...) "
+                    "or set serving.spec_draft_model ('self' = the "
+                    "target drafts for itself; docs/serving.md "
+                    "'Speculative decoding')")
+            from deepspeed_tpu.models.opt import opt_model
+            tcfg = getattr(engine.module, "config", None)
+            draft_module = opt_model(
+                name,
+                vocab_size=getattr(tcfg, "vocab_size", 50272),
+                max_seq_len=max(getattr(tcfg, "max_seq_len", 2048),
+                                int(cfg.max_cache_len)),
+                dtype=getattr(tcfg, "dtype", "bfloat16"))
+            if draft_params is None:
+                logger.warning(
+                    f"serving.spec_draft_model={name!r} with no "
+                    f"draft_params — RANDOM draft weights: the accept "
+                    f"rate will be ~0 and speculation will SLOW decode; "
+                    f"pass trained weights via "
+                    f"engine.serve(draft_params=...)")
+                draft_params = draft_module.init(
+                    jax.random.key(0),
+                    {"input_ids": jnp.zeros((1, 8), jnp.int32)})
+        elif draft_params is None:
+            raise ValueError("draft_module passed without draft_params")
+        if draft_params is engine._params:
+            return draft_module, draft_params
+        # cast AND place replicated on the engine mesh (set_params'
+        # discipline): unplaced draft params would compile the whole
+        # draft program chain single-device, and its committed outputs
+        # would then clash with the mesh-replicated slot state the
+        # target programs produce
+        from jax.sharding import NamedSharding, PartitionSpec
+        cast = engine.compute_dtype
+        put = jax.jit(
+            lambda t: jax.tree.map(
+                lambda p: p.astype(cast)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p, t),
+            out_shardings=NamedSharding(engine.mesh, PartitionSpec()))
+        return draft_module, put(draft_params)
 
     # ------------------------------------------------------------------ #
     # Public API
@@ -495,11 +680,19 @@ class ServingEngine:
                 f"serving.priority_lanes={self.priority_lanes} "
                 f"(0 = most urgent)")
         padded = -(-P // self.chunk) * self.chunk
-        need = max(P + max_new, padded)
+        # speculative serving reserves spec_k-1 tail positions per lane:
+        # a live lane's last verify window writes K/V for up to spec_k
+        # draft tokens past its final committed position (overwritten or
+        # never attended — but they must land INSIDE the lane)
+        spec_tail = (self.spec_k - 1) if self.speculative else 0
+        need = max(P + max_new + spec_tail, padded)
         if need > self.cache_len:
             raise ValueError(
                 f"request needs {need} cache positions (prompt {P} + new "
-                f"{max_new}, chunk-padded {padded}) but slot lanes hold "
+                f"{max_new}"
+                + (f" + speculative window reserve {spec_tail}"
+                   if spec_tail else "")
+                + f", chunk-padded {padded}) but slot lanes hold "
                 f"{self.cache_len} — raise serving.max_cache_len or split "
                 f"the request")
         if self.paged and pages_for(need, self.page) > self._pool.allocatable:
@@ -612,7 +805,7 @@ class ServingEngine:
                 self._cond.notify_all()      # a queue spot freed
                 return True
             if self._pending is not None and self._pending.req is req:
-                self._lane_pool.give_back(self._pending.lane)
+                self._give_back_lanes(self._pending)
                 self._free.append(int(self._pending.slot))
                 self._release_slot_pages(self._pending.slot)
                 self._pending = None
@@ -705,6 +898,22 @@ class ServingEngine:
             for s in streams:
                 s.push(ev)
 
+    def _release_draft_workspaces(self):  # lock-held: _lock
+        """Free every draft-side buffer (close/preempt teardown)."""
+        if not self.speculative:
+            return
+        self._draft_cache = None
+        self._draft_ws.release()
+        self._draft_lanes.release()
+
+    def _give_back_lanes(self, p):  # lock-held: _lock
+        """Return a dropped admission's prefill lane(s) to their pools —
+        the target lane and, under speculation, the draft lane."""
+        self._lane_pool.give_back(p.lane)
+        if self.speculative and p.draft_lane is not None:
+            self._draft_lanes.give_back(p.draft_lane)
+            p.draft_lane = None
+
     def _release_slot_pages(self, slot):  # lock-held: _lock
         """Paged mode: return a retired slot's pages to the pool (shared
         prefix pages just drop one reference) and point its table row at
@@ -781,7 +990,7 @@ class ServingEngine:
         p = self._pending
         if p is not None and p.req.deadline is not None \
                 and now >= p.req.deadline:
-            self._lane_pool.give_back(p.lane)
+            self._give_back_lanes(p)
             self._free.append(int(p.slot))
             self._release_slot_pages(p.slot)
             self._pending = None
@@ -1056,6 +1265,7 @@ class ServingEngine:
         self._state = None
         self._cache_ws.release()
         self._lane_pool.release()
+        self._release_draft_workspaces()
         if self.paged:
             self._pool_ws.release()
         self._closed = True
@@ -1091,13 +1301,19 @@ class ServingEngine:
             if req.status not in TERMINAL_STATUSES:
                 self._record_terminal(req, RequestStatus.ABORTED,
                                       f"admission aborted: {why}")
-            self._lane_pool.give_back(self._pending.lane)
+            self._give_back_lanes(self._pending)
             self._pending = None
         self._events.clear()
         self._slots = [None] * self.num_slots
         self._free = deque(range(self.num_slots))
         self._mirror_active[:] = False
         self._state = None
+        if self.speculative:
+            # the draft cache's contents mirror the aborted in-flight
+            # requests (and may be donated-dead after a failed propose)
+            # — drop it so the next step reallocates a fresh one
+            self._draft_ws.give_back(self._draft_cache)
+            self._draft_cache = None
         self._paging_reset()
         if lost:
             self.stats["aborted"] = self.stats.get("aborted", 0) + len(lost)
@@ -1233,10 +1449,19 @@ class ServingEngine:
                      jax.ShapeDtypeStruct((1,), jnp.int32))
             report.update(warm(self._chunk_fn, cargs,
                                f"serving_prefill_paged:c{C}p{self.page}"))
-            report.update(warm(
-                self._decode_fn, (eng._params, cache, state, tables, rng),
-                f"serving_decode_paged:n{N}s{S}b{self.block}"
-                f"p{self.page}"))
+            if self.speculative:
+                draft = jax.ShapeDtypeStruct((N, self.spec_k), jnp.int32)
+                report.update(warm(
+                    self._verify_fn,
+                    (eng._params, cache, state, tables, draft, rng),
+                    f"serving_spec_verify_paged:n{N}s{S}k{self.spec_k}"
+                    f"p{self.page}"))
+            else:
+                report.update(warm(
+                    self._decode_fn,
+                    (eng._params, cache, state, tables, rng),
+                    f"serving_decode_paged:n{N}s{S}b{self.block}"
+                    f"p{self.page}"))
         else:
             cargs = (eng._params, lane,
                      jax.ShapeDtypeStruct((1, C), jnp.int32),
@@ -1244,9 +1469,32 @@ class ServingEngine:
                      jax.ShapeDtypeStruct((1,), jnp.int32))
             report.update(warm(self._chunk_fn, cargs,
                                f"serving_prefill:c{C}"))
-            report.update(warm(self._decode_fn,
-                               (eng._params, cache, state, rng),
-                               f"serving_decode:n{N}s{S}b{self.block}"))
+            if self.speculative:
+                draft = jax.ShapeDtypeStruct((N, self.spec_k), jnp.int32)
+                report.update(warm(
+                    self._verify_fn,
+                    (eng._params, cache, state, draft, rng),
+                    f"serving_spec_verify:n{N}s{S}k{self.spec_k}"))
+            else:
+                report.update(warm(self._decode_fn,
+                                   (eng._params, cache, state, rng),
+                                   f"serving_decode:n{N}s{S}"
+                                   f"b{self.block}"))
+        if self.speculative:
+            dcache = jax.eval_shape(
+                lambda: self.draft_module.init_cache(N, S, dtype=dtype))
+            dlane = jax.eval_shape(
+                lambda: self.draft_module.init_cache(1, S, dtype=dtype))
+            report.update(warm(
+                self._propose_fn, (self._draft_params, dcache, state),
+                f"serving_spec_propose:n{N}s{S}k{self.spec_k}"))
+            report.update(warm(
+                self._draft_chunk_fn,
+                (self._draft_params, dlane,
+                 jax.ShapeDtypeStruct((1, C), jnp.int32),
+                 jax.ShapeDtypeStruct((), jnp.int32),
+                 jax.ShapeDtypeStruct((1,), jnp.int32)),
+                f"serving_spec_draft_prefill:c{C}"))
         for name, dt in report.items():
             log_dist(f"serving warmup[{name}]: "
                      + ("cached" if dt == 0.0 else f"{dt:.1f}s"), ranks=[0])
@@ -1342,7 +1590,11 @@ class ServingEngine:
         ids_pad[0, :P] = fill
         lane = self._lane_pool.take(self.cache_len,
                                     self.engine.compute_dtype)
-        return _PendingPrefill(req, slot, lane, ids_pad, n, P)
+        pend = _PendingPrefill(req, slot, lane, ids_pad, n, P)
+        if self.speculative:
+            pend.draft_lane = self._draft_lanes.take(
+                self.cache_len, self.engine.compute_dtype)
+        return pend
 
     def _start_prefill_paged(self, req, fill, P):  # lock-held: _lock
         """Paged admission: map the longest indexed prefix (full pages,
@@ -1352,10 +1604,14 @@ class ServingEngine:
         nothing allocated) when the pool cannot back the request yet."""
         dev_new = req.max_new - len(req.prefix)
         matched = []
-        if self.config.prefix_cache:
+        if self.config.prefix_cache and not self.speculative:
             # cap the match so the block holding the LAST prompt position
             # is always recomputed: admission samples the first token
             # from that position's logits, so at least one chunk must run
+            # (speculative serving skips prefix sharing: the DRAFT cache
+            # has no page pool, so its prefill must run from position 0
+            # anyway — a shared target prefix would leave the draft side
+            # unfilled; docs/serving.md "Speculative decoding")
             matched = self._prefix.lookup(fill, self.page, self._pool,
                                           (P - 1) // self.page)
         m = len(matched)
@@ -1387,7 +1643,7 @@ class ServingEngine:
             for pg in matched:
                 self._pool.decref(pg)
             return None
-        if self.config.prefix_cache:
+        if self.config.prefix_cache and not self.speculative:
             # stats count ADMISSIONS, not stalled retries of the same
             # request (a 50-step stall must not record 50 lookups/hits)
             self.stats["prefix_lookups"] += 1
@@ -1406,6 +1662,11 @@ class ServingEngine:
         pend = _PendingPrefill(req, slot, None, ids_pad, n_chunks, P)
         pend.start = s0
         pend.fill_tokens = fill
+        if self.speculative:
+            # s0 == 0 under speculation (prefix sharing disabled), so
+            # the draft lane prefills the same chunk spans as the pool
+            pend.draft_lane = self._draft_lanes.take(
+                self.cache_len, self.engine.compute_dtype)
         return pend
 
     def _run_prefill_chunk(self, p):  # lock-held: _lock
@@ -1450,7 +1711,7 @@ class ServingEngine:
                 raise
             # the donated lane may be dead — drop only THIS admission
             # (the decode workspace is untouched by a prefill failure)
-            self._lane_pool.give_back(p.lane)
+            self._give_back_lanes(p)
             self._free.append(int(p.slot))
             self._pending = None
             if p.req.status not in TERMINAL_STATUSES:
@@ -1462,6 +1723,40 @@ class ServingEngine:
             logger.warning(f"serving prefill failed — request "
                            f"{p.req.rid} dropped")
             raise
+        if self.speculative:
+            # mirror the chunk into the DRAFT lane: speculation proposes
+            # from the draft model's own cache, so it needs the prompt's
+            # K/V too (same spans — prefix sharing is disabled under
+            # speculation, p.start is always 0)
+            t0s = time.perf_counter()
+            try:
+                _, p.draft_lane = self.engine._run_guarded(
+                    self._draft_chunk_fn,
+                    (self._draft_params, p.draft_lane,
+                     jnp.asarray(p.ids_pad[:, p.ci * C:(p.ci + 1) * C]),
+                     jnp.asarray(p.start + p.ci * C, jnp.int32),
+                     jnp.asarray([local], jnp.int32)))
+            except BaseException as e:
+                # the donated draft lane may be dead — drop only THIS
+                # admission.  The target side's partial writes are freed
+                # with the slot (monolithic lane back to the pool, paged
+                # pages decref'd) and overwritten by the next occupant
+                # before any of its queries attend them.
+                self._give_back_lanes(p)
+                self._free.append(int(p.slot))
+                self._release_slot_pages(p.slot)
+                self._pending = None
+                if p.req.status not in TERMINAL_STATUSES:
+                    self._record_terminal(
+                        p.req, RequestStatus.ABORTED,
+                        f"draft prefill dispatch failed: "
+                        f"{type(e).__name__}: {e}")
+                    self.stats["aborted"] = \
+                        self.stats.get("aborted", 0) + 1
+                logger.warning(f"serving draft prefill failed — request "
+                               f"{p.req.rid} dropped")
+                raise
+            self.stats["spec_draft_secs"] += time.perf_counter() - t0s
         self._breaker.record_success()
         if (P - 1 - p.start) // C == p.ci:
             # this chunk held the prompt's last real position — its
@@ -1512,7 +1807,7 @@ class ServingEngine:
             if not self.paged:
                 self._cache_ws.give_back(self._cache)
                 self._cache = None
-            self._lane_pool.give_back(p.lane)
+            self._give_back_lanes(p)
             if req.status not in TERMINAL_STATUSES:
                 self._record_terminal(req, RequestStatus.ABORTED,
                                       f"admit dispatch failed: "
@@ -1522,7 +1817,7 @@ class ServingEngine:
             raise
         self._breaker.record_success()
         if self.paged and self.config.prefix_cache \
-                and p.fill_tokens is not None:
+                and not self.speculative and p.fill_tokens is not None:
             # index this request's full-prompt pages as sharable —
             # their prefill writes are complete (dispatched before this
             # admit) and nothing ever writes them again (the slot's own
@@ -1530,10 +1825,33 @@ class ServingEngine:
             self._prefix.register(p.fill_tokens, self.page,
                                   self._slot_pages[p.slot], self._pool,
                                   p.fill_len // self.page)
+        if self.speculative:
+            # insert the prefilled draft lane into the draft cache (the
+            # draft-side twin of the target admit's lane insert)
+            t0s = time.perf_counter()
+            try:
+                self._draft_cache = self.engine._run_guarded(
+                    self._draft_admit_fn,
+                    (self._draft_cache, p.draft_lane,
+                     jnp.asarray(p.slot, jnp.int32)))
+            except BaseException as e:
+                # the donated draft cache may be dead — decode-grade
+                # failure: every live slot's draft K/V lived in it
+                self._give_back_lanes(p)
+                if req.status not in TERMINAL_STATUSES:
+                    self._record_terminal(
+                        req, RequestStatus.ABORTED,
+                        f"draft admit dispatch failed: "
+                        f"{type(e).__name__}: {e}")
+                self._abort_in_flight(f"draft admit dispatch failed "
+                                      f"(request {req.rid} lost)")
+                raise
+            self.stats["spec_draft_secs"] += time.perf_counter() - t0s
         self._slot_last_dispatch[int(p.slot)] = time.monotonic()
         req.status = RequestStatus.RUNNING
         self._slots[p.slot] = req
-        self._events.append(("admit", req, p.slot, p.lane, first))
+        self._events.append(("admit", req, p.slot, p.lane, first,
+                             p.draft_lane))
         self.stats["admitted"] += 1
 
     # ------------------------------------------------------------------ #
@@ -1548,15 +1866,19 @@ class ServingEngine:
         self._rng, sub = jax.random.split(self._rng)
         try:
             inject.fire("serving.pre_decode_dispatch")
-            if self.paged:
+            if self.speculative:
+                ev = self._dispatch_spec(sub)
+            elif self.paged:
                 toks, self._cache, self._state = self.engine._run_guarded(
                     self._decode_fn,
                     (self.engine._params, self._cache, self._state,
                      jnp.asarray(self._page_table), sub))
+                ev = ("decode", toks)
             else:
                 toks, self._cache, self._state = self.engine._run_guarded(
                     self._decode_fn,
                     (self.engine._params, self._cache, self._state, sub))
+                ev = ("decode", toks)
         except BaseException:
             # the donated cache/state may be dead — drop them so the next
             # step's workspace take() reallocates, and abort everything
@@ -1575,9 +1897,40 @@ class ServingEngine:
         for s, r in enumerate(self._slots):
             if r is not None:
                 self._slot_last_dispatch[s] = now
-        self._events.append(("decode", toks))
+        self._events.append(ev)
         self.stats["decode_calls"] += 1
         return True
+
+    def _dispatch_spec(self, sub):  # lock-held: _lock
+        """One speculative round, two device-chained dispatches and zero
+        host syncs: the draft proposes ``spec_k`` greedy tokens per slot
+        from its OWN cache (draft cache donated through), then the
+        target verifies the whole window in ONE batched forward and
+        commits the accepted prefix in-program (cache + slot state
+        donated).  The draft tokens never touch the host — they flow
+        propose → verify as a device array.  A failure in either
+        dispatch is handled by the caller's decode-failure recovery
+        (``_abort_in_flight`` drops the draft cache too)."""
+        t0 = time.perf_counter()
+        draft, self._draft_cache = self.engine._run_guarded(
+            self._propose_fn,
+            (self._draft_params, self._draft_cache, self._state))
+        t1 = time.perf_counter()
+        self.stats["spec_draft_secs"] += t1 - t0
+        if self.paged:
+            toks, accepted, self._cache, self._state = \
+                self.engine._run_guarded(
+                    self._verify_fn,
+                    (self.engine._params, self._cache, self._state,
+                     jnp.asarray(self._page_table), draft, sub))
+        else:
+            toks, accepted, self._cache, self._state = \
+                self.engine._run_guarded(
+                    self._verify_fn,
+                    (self.engine._params, self._cache, self._state,
+                     draft, sub))
+        self.stats["spec_verify_secs"] += time.perf_counter() - t1
+        return ("spec", toks, accepted)
 
     # ------------------------------------------------------------------ #
     # Event processing (the host's lagging mirror of the device)
@@ -1588,15 +1941,19 @@ class ServingEngine:
             ev = self._events.popleft()
             if ev[0] == "admit":
                 self._process_admit(ev, finished)
+            elif ev[0] == "spec":
+                self._process_spec(ev, finished)
             else:
                 self._process_decode(ev, finished)
 
     def _process_admit(self, ev, finished):  # lock-held: _lock
-        _, req, slot, lane, first_dev = ev
+        _, req, slot, lane, first_dev, draft_lane = ev
         t0 = time.perf_counter()
         first = int(np.asarray(first_dev))
         self.stats["sync_secs"] += time.perf_counter() - t0
         self._lane_pool.give_back(lane)
+        if self.speculative and draft_lane is not None:
+            self._draft_lanes.give_back(draft_lane)
         if req.status in TERMINAL_STATUSES:
             # shed/cancelled while the admit event was in flight: free
             # the slot now (the shed path left it to us), discard the
@@ -1625,6 +1982,29 @@ class ServingEngine:
             self._mirror_active[slot] = True
             self._publish_progress(req)
 
+    def _mirror_commit_token(self, s, req, tok, finished):  # lock-held: _lock
+        """The ONE per-token mirror rule both decode paths (plain block
+        and speculative window) share: append the committed token,
+        account it, and either retire the slot (eos or budget exhausted
+        — mirroring the in-program rule) or flush the per-token stream
+        at this drain point (the stream's tick — one event behind the
+        device, TTFT/time-between-tokens observable here).  Returns
+        True when the slot retired."""
+        req.tokens.append(tok)
+        self.stats["decode_tokens"] += 1
+        if self._fairness is not None:
+            self._fairness.charge(req.client_id, 1)
+        if (req.eos >= 0 and tok == req.eos) \
+                or len(req.tokens) >= req.max_new:
+            self._mirror_active[s] = False
+            self._slots[s] = None
+            self._free.append(int(s))
+            self._release_slot_pages(s)
+            finished[req.rid] = self._finalize(req)
+            return True
+        self._publish_progress(req)
+        return False
+
     def _process_decode(self, ev, finished):  # lock-held: _lock
         t0 = time.perf_counter()
         toks = np.asarray(ev[1])                         # [block, N]
@@ -1635,23 +2015,49 @@ class ServingEngine:
             row = toks[t]
             for s in np.nonzero(self._mirror_active)[0]:
                 req = self._slots[s]
-                tok = int(row[s])
-                req.tokens.append(tok)
-                self.stats["decode_tokens"] += 1
-                if self._fairness is not None:
-                    self._fairness.charge(req.client_id, 1)
-                if (req.eos >= 0 and tok == req.eos) \
-                        or len(req.tokens) >= req.max_new:
-                    self._mirror_active[s] = False
-                    self._slots[s] = None
-                    self._free.append(int(s))
-                    self._release_slot_pages(s)
-                    finished[req.rid] = self._finalize(req)
-                else:
-                    # per-token streaming flush: the host-mirror drain
-                    # point IS the stream's tick — one event behind the
-                    # device, TTFT/time-between-tokens observable here
-                    self._publish_progress(req)
+                self._mirror_commit_token(s, req, int(row[s]), finished)
+        self.occupancy_trace.append(
+            (self._it, int(self._mirror_active.sum())))
+
+    def _process_spec(self, ev, finished):  # lock-held: _lock
+        """Mirror one speculative round: per live slot, append EXACTLY
+        the ``accepted[s]`` committed tokens (the device's in-program
+        accept count — rows beyond it are window padding, never real
+        tokens) and apply the same per-token eos/max_new retirement rule
+        the plain decode mirror applies.  Each committed token is pushed
+        to the request's stream subscribers individually at this drain
+        point, so a dispatch that commits m tokens emits m ORDERED
+        per-token events with monotonic indices — never one blob per
+        dispatch — and mid-window retirement cuts the stream exactly at
+        the terminal token."""
+        _, toks_dev, acc_dev = ev
+        t0 = time.perf_counter()
+        toks = np.asarray(toks_dev)                      # [spec_k+1, N]
+        acc = np.asarray(acc_dev)                        # [N]
+        self.stats["sync_secs"] += time.perf_counter() - t0
+        self.stats["spec_rounds"] += 1
+        for s in np.nonzero(self._mirror_active)[0]:
+            req = self._slots[s]
+            m = int(acc[s])
+            self.stats["spec_windows"] += 1
+            self.stats["spec_committed_tokens"] += m
+            for i in range(m):
+                # by the in-program commit rule the device stopped
+                # committing at exactly the token that retires here
+                if self._mirror_commit_token(s, req, int(toks[i, s]),
+                                             finished):
+                    break
+        # derived rates for /metrics + Serving/spec_* monitor events
+        w = self.stats["spec_windows"]
+        if w:
+            committed = self.stats["spec_committed_tokens"]
+            self.stats["spec_accept_rate"] = \
+                (committed - w) / (w * self.spec_k)
+            self.stats["spec_tokens_per_dispatch"] = \
+                committed / self.stats["spec_rounds"]
+        d, v = self.stats["spec_draft_secs"], self.stats["spec_verify_secs"]
+        if d + v > 0:
+            self.stats["spec_draft_fraction"] = d / (d + v)
         self.occupancy_trace.append(
             (self._it, int(self._mirror_active.sum())))
 
@@ -1758,7 +2164,7 @@ class ServingEngine:
         # retire the engine without ABORTED accounting: the snapshotted
         # requests are not lost, they resume elsewhere
         if self._pending is not None:
-            self._lane_pool.give_back(self._pending.lane)
+            self._give_back_lanes(self._pending)
             self._pending = None
         self._queue.clear()
         self._events.clear()
@@ -1774,6 +2180,7 @@ class ServingEngine:
         self._state = None
         self._cache_ws.release()
         self._lane_pool.release()
+        self._release_draft_workspaces()
         self._paging_reset()
         if self.paged:
             self._pool_ws.release()
@@ -1922,7 +2329,9 @@ class ServingEngine:
             # — admitting an oversized request would stream prefill
             # chunks past the lane's end)
             P = len(ids)
-            need = max(P + max_new, -(-P // self.chunk) * self.chunk)
+            spec_tail = (self.spec_k - 1) if self.speculative else 0
+            need = max(P + max_new + spec_tail,
+                       -(-P // self.chunk) * self.chunk)
             if need > self.cache_len:
                 self._requests[req.rid] = req
                 self._record_terminal(
@@ -1959,7 +2368,7 @@ class ServingEngine:
             # wasteful
             fill = P + len(prefix)
             padded = -(-fill // self.chunk) * self.chunk
-            if prefix and max(fill + (max_new - len(prefix)),
+            if prefix and max(fill + (max_new - len(prefix)) + spec_tail,
                               padded) > self.cache_len:
                 logger.warning(
                     f"serving restore: request {req.rid} prefix "
@@ -1993,6 +2402,9 @@ class ServingEngine:
                 self._cache = self._cache_ws.take(
                     self.num_slots, self.cache_len,
                     self.engine.compute_dtype)
+        if self.speculative and self._draft_cache is None:
+            self._draft_cache = self._draft_ws.take(
+                self.num_slots, self.cache_len, self.engine.compute_dtype)
         if self._state is None:
             self._state = {k: jnp.asarray(v) for k, v in
                            init_slot_state(self.num_slots).items()}
@@ -2030,4 +2442,11 @@ class ServingEngine:
             ("Serving/page_pool_util", self.page_pool_utilization,
              self._it),
             ("Serving/prefix_hit_rate", self.prefix_hit_rate, self._it),
-        ] if self.paged else []))
+        ] if self.paged else []) + ([
+            ("Serving/spec_accept_rate",
+             self.stats["spec_accept_rate"], self._it),
+            ("Serving/spec_tokens_per_dispatch",
+             self.stats["spec_tokens_per_dispatch"], self._it),
+            ("Serving/spec_draft_fraction",
+             self.stats["spec_draft_fraction"], self._it),
+        ] if self.speculative else []))

@@ -230,6 +230,206 @@ def make_paged_chunk_fn(module, param_transform):
     return jax.jit(chunk_step, donate_argnums=(1,))
 
 
+# --------------------------------------------------------------------- #
+# Speculative decoding (docs/serving.md "Speculative decoding"): a small
+# DRAFT model proposes k tokens per live slot, the target model verifies
+# all of them in ONE batched forward, and the accepted prefix advances
+# both KV caches through the existing per-row scatter writes.  Fixed k,
+# accept math entirely in-program, the accept-mask and per-slot accepted
+# length as traced values riding the donated slot state — so exactly one
+# draft-propose program and one verify-and-commit program serve the whole
+# server lifetime, like every other slot program.  Greedy committed
+# tokens are the TARGET's sample_fn outputs over the committed history,
+# which is what keeps speculative serving bitwise equal to the
+# non-speculative decode step.
+# --------------------------------------------------------------------- #
+
+def _spec_commit(t, draft, state, k, cache_len):
+    """The in-program accept-and-commit rule shared by the monolithic and
+    paged verify programs.
+
+    ``t`` ``[N, k+1]``: the target's sampled token at every window
+    position (``t[:, i]`` is sampled from the logits AFTER feeding
+    ``[token, d_1..d_i]``); ``draft`` ``[N, k]``: the draft proposals.
+    Token ``t[:, i]`` is committed iff every earlier draft matched
+    (``d_j == t_j`` for ``j < i`` — the leading-match prefix, so every
+    committed token is exactly what the non-speculative decode step
+    would have sampled), the slot still had budget (``i < remaining``),
+    no earlier committed token was the slot's ``eos``, and the lane is
+    live.  Returns ``(tokens [k+1, N], accepted [N], new_state)`` with
+    the same emit/retire conventions as ``make_decode_block_fn``:
+    uncommitted positions emit the slot's ``eos``, lanes retire
+    in-program on eos or budget exhaustion, dead lanes commit nothing."""
+    eos, active = state["eos"], state["active"]
+    remaining, pos = state["remaining"], state["pos"]
+    # leading-match prefix: how many drafts the target reproduced
+    match = (draft == t[:, :k]).astype(jnp.int32)            # [N, k]
+    n_match = jnp.sum(jnp.cumprod(match, axis=1), axis=1)    # [N]
+    m_raw = 1 + n_match                                      # 1..k+1
+    idx = jnp.arange(k + 1)[None, :]
+    base = (idx < m_raw[:, None]) & (idx < remaining[:, None]) \
+        & active[:, None]
+    eos_hit = base & (t == eos[:, None])
+    # commit stops AFTER the first committed eos (inclusive) — the same
+    # per-step rule the non-spec block applies, folded over the window
+    ex_eos = jnp.cumsum(eos_hit.astype(jnp.int32), axis=1) \
+        - eos_hit.astype(jnp.int32)
+    committed = base & (ex_eos == 0)                         # [N, k+1]
+    m_eff = jnp.sum(committed.astype(jnp.int32), axis=1)     # [N]
+    last = jnp.take_along_axis(
+        t, jnp.clip(m_eff - 1, 0, k)[:, None], axis=1)[:, 0]
+    done_now = active & (jnp.any(eos_hit, axis=1)
+                         | (remaining <= m_eff))
+    new_state = {
+        "token": jnp.where(active, last, eos),
+        # live lanes stay in bounds by submit()'s spec window reserve;
+        # the clamp keeps dead lanes' masked writes inside the buffer
+        "pos": jnp.minimum(pos + m_eff, cache_len - 1),
+        "active": active & jnp.logical_not(done_now),
+        "remaining": jnp.maximum(remaining - m_eff, 0),
+        "eos": eos,
+    }
+    toks = jnp.where(committed, t, eos[:, None]).T           # [k+1, N]
+    return toks, m_eff, new_state
+
+
+def make_draft_propose_fn(draft_module, param_transform, k, cache_len):
+    """The draft-propose program:
+    ``fn(draft_params, draft_cache, state) -> (draft [N, k], draft_cache)``
+    with ONLY the draft KV workspace donated (argnum 1) — the slot state
+    is read-only here (the verify program owns its donation).
+
+    ``k+1`` greedy single-token draft steps in one in-program scan:
+    write the pending token at ``pos``, argmax the draft logits, repeat.
+    The extra (k+1)-th step is WRITE-ONLY bookkeeping (its sample is
+    discarded): a fully-accepted window advances ``pos`` by ``k+1``, and
+    without it the draft cache would hold a one-position hole at
+    ``pos+k`` that the next window's queries would attend as garbage.
+    The draft samples greedily regardless of the serving sampling config
+    — draft quality only moves the ACCEPT RATE, never the committed
+    tokens (those are always the target's)."""
+    deq = param_transform if param_transform is not None else (lambda p: p)
+
+    @hot_path("serving.spec_propose")
+    def propose(draft_params, draft_cache, state):
+        eos, active = state["eos"], state["active"]
+
+        def step(carry, _):
+            cache, tok, pos = carry
+            logits, cache = draft_module.apply(
+                deq(draft_params), tok[:, None], cache, pos,
+                method=type(draft_module).decode)
+            nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            nxt = jnp.where(active, nxt, eos)
+            pos = jnp.minimum(pos + 1, cache_len - 1)
+            return (cache, nxt, pos), nxt
+
+        (draft_cache, _, _), drafts = jax.lax.scan(
+            step, (draft_cache, state["token"], state["pos"]), None,
+            length=k + 1)
+        return drafts[:k].T, draft_cache            # [N, k]
+
+    return jax.jit(propose, donate_argnums=(1,))
+
+
+def make_spec_verify_fn(module, sample_fn, param_transform, k, cache_len):
+    """The verify-and-commit program:
+    ``fn(params, cache, state, draft, rng) -> (tokens [k+1, N],
+    accepted [N], cache, state)`` with the TARGET cache and the slot
+    state donated (argnums 1, 2).
+
+    ONE batched target forward over ``[token, d_1..d_k]`` per slot
+    (per-row start positions — the cache write is the per-row
+    MULTI-token scatter, ``models/transformer.py``), then the shared
+    :func:`_spec_commit` accept rule.  Every committed token is the
+    target's ``sample_fn`` output over exactly the committed history
+    (the accepted drafts match it position by position), which is the
+    bitwise-greedy contract; K/V written for rejected window positions
+    is overwritten position-by-position by later windows before any
+    query can attend it — the same argument chunked prefill's padded
+    tail already relies on."""
+    deq = param_transform if param_transform is not None else (lambda p: p)
+
+    @hot_path("serving.spec_verify")
+    def verify(params, cache, state, draft, rng):
+        ids = jnp.concatenate([state["token"][:, None], draft], axis=1)
+        logits, cache = module.apply(deq(params), ids, cache,
+                                     state["pos"],
+                                     method=type(module).decode)
+        rngs = jax.random.split(rng, k + 1)
+        t = jnp.stack([sample_fn(logits[:, i], rngs[i]).astype(jnp.int32)
+                       for i in range(k + 1)], axis=1)
+        toks, accepted, new_state = _spec_commit(t, draft, state, k,
+                                                 cache_len)
+        return toks, accepted, cache, new_state
+
+    return jax.jit(verify, donate_argnums=(1, 2))
+
+
+def make_paged_spec_verify_fn(module, sample_fn, param_transform, k,
+                              cache_len):
+    """The PAGED verify-and-commit program: pool + slot state donated
+    (argnums 1, 2), the per-slot page tables a plain traced input.  Same
+    accept math as :func:`make_spec_verify_fn`; like the paged decode
+    step, inactive lanes' whole table row redirects to the trash page so
+    their window writes can never land in pages the host already handed
+    to a newer occupant."""
+    deq = param_transform if param_transform is not None else (lambda p: p)
+
+    @hot_path("serving.spec_verify_paged")
+    def verify(params, cache, state, pages, draft, rng):
+        safe_pages = jnp.where(state["active"][:, None], pages, 0)
+        ids = jnp.concatenate([state["token"][:, None], draft], axis=1)
+        logits, cache = module.apply(deq(params), ids,
+                                     {**cache, "pages": safe_pages},
+                                     state["pos"],
+                                     method=type(module).decode)
+        rngs = jax.random.split(rng, k + 1)
+        t = jnp.stack([sample_fn(logits[:, i], rngs[i]).astype(jnp.int32)
+                       for i in range(k + 1)], axis=1)
+        toks, accepted, new_state = _spec_commit(t, draft, state, k,
+                                                 cache_len)
+        return toks, accepted, cache, new_state
+
+    return jax.jit(verify, donate_argnums=(1, 2))
+
+
+def make_draft_chunk_fn(draft_module, param_transform):
+    """The draft-side admission-prefill chunk program — same body as the
+    engine's per-chunk program, bound to the DRAFT module: speculation
+    needs the prompt's K/V in the draft cache too, so admission streams
+    every chunk through both models (the draft lane is donated, argnum
+    1).  The selected logits are computed for body parity but discarded
+    — the first token is sampled by the TARGET admit program."""
+    deq = param_transform if param_transform is not None else (lambda p: p)
+
+    @hot_path("serving.spec_draft_prefill")
+    def chunk_step(draft_params, lane, chunk_ids, start, logits_at):
+        return draft_module.apply(deq(draft_params), chunk_ids, lane,
+                                  start, method=type(draft_module).decode,
+                                  logits_at=logits_at)
+
+    return jax.jit(chunk_step, donate_argnums=(1,))
+
+
+def make_draft_admit_fn():
+    """The draft-side admission program: insert the prefilled draft lane
+    into slot ``slot`` of the draft cache (``dynamic_update_slice`` over
+    the traced slot index; draft cache donated, argnum 0).  No sampling,
+    no state write — the target admit program owns both."""
+
+    @hot_path("serving.spec_draft_admit")
+    def admit(draft_cache, lane, slot):
+        def ins(buf, lbuf):
+            return jax.lax.dynamic_update_slice(
+                buf, lbuf.astype(buf.dtype), (0, slot, 0, 0))
+
+        return {kk: ins(draft_cache[kk], lane[kk]) for kk in draft_cache}
+
+    return jax.jit(admit, donate_argnums=(0,))
+
+
 def make_paged_admit_fn(sample_fn):
     """The paged admission program:
     ``fn(state, logits, rng, slot, pos0, max_new, eos) -> (state,

@@ -410,7 +410,7 @@ def _paged_write(cache, k_new, v_new, ks_new, vs_new, positions, per_row):
     pages = cache["pages"]                      # [B, n_pages] int32
     page = cache["k"].shape[-2]
     B_, S_ = k_new.shape[0], k_new.shape[1]
-    if per_row:
+    if per_row and S_ == 1:
         pos = positions[:, 0]                   # [B] per-row decode
         pidx = (pos // page).astype(jnp.int32)
         off = (pos % page).astype(jnp.int32)
@@ -418,6 +418,20 @@ def _paged_write(cache, k_new, v_new, ks_new, vs_new, positions, per_row):
 
         def w(buf, new):
             return buf.at[li, phys, off].set(new[:, 0].astype(buf.dtype))
+    elif per_row:
+        # per-row MULTI-token block (speculative verify): each row writes
+        # S_ contiguous positions from ITS OWN start, resolved through
+        # its table row in one batched scatter.  Dead lanes' table rows
+        # are redirected to the trash page by the caller, so their
+        # (possibly lane-overflowing, gather-clamped) virtual positions
+        # can only ever land on trash.
+        pos = positions                         # [B, S]
+        pidx = (pos // page).astype(jnp.int32)
+        off = (pos % page).astype(jnp.int32)
+        phys = jnp.take_along_axis(pages, pidx, axis=1)      # [B, S]
+
+        def w(buf, new):
+            return buf.at[li, phys, off].set(new.astype(buf.dtype))
     else:
         # row-uniform multi-token block (chunked prefill / shared-pos
         # decode): positions start..start+S-1 may span page boundaries
@@ -733,6 +747,22 @@ class Attention(nn.Module):
                             new[:, 0].astype(buf.dtype))
                     return buf.at[li, rows, pos_rows].set(
                         new[:, 0].astype(buf.dtype))
+            elif "per_row" in cache:
+                # per-row MULTI-token block (the serving engine's
+                # speculative verify): each row writes S_ contiguous
+                # positions from ITS OWN start in one batched scatter.
+                # Positions past the buffer (dead lanes' clamped
+                # windows) are dropped by scatter's out-of-bounds rule;
+                # in-bounds writes land inside the row's own lane.
+                rows2d = jnp.arange(B_)[:, None]             # [B, 1]
+
+                def write_rows(buf, new, li=None):
+                    # buf [L, B, S, KD] or [B, S, KD], new [B, S_, KD]
+                    if li is None:
+                        return buf.at[rows2d, positions].set(
+                            new.astype(buf.dtype))
+                    return buf.at[li, rows2d, positions].set(
+                        new.astype(buf.dtype))
             else:
                 # row-uniform write: decode at a shared position, or a
                 # multi-token prefill block from the start position
@@ -755,7 +785,7 @@ class Attention(nn.Module):
                 # change this program's shape.
                 data = _paged_write(
                     cache, k_new, v_new, ks_new, vs_new, positions,
-                    per_row=(S_ == 1 and "per_row" in cache))
+                    per_row=("per_row" in cache))
                 new_cache = {**data, "layer": cache["layer"],
                              "pages": cache["pages"],
                              **({"per_row": cache["per_row"]}

@@ -273,6 +273,99 @@ def serving_admit_paged():
                       expect_donation=True)
 
 
+def serving_spec_propose():
+    """The speculative draft-propose program: k+1 greedy draft steps in
+    one in-program scan (the extra step is the write-only cache
+    catch-up), ONLY the draft KV workspace donated — the slot state is
+    read-only here (the verify program owns its donation)."""
+    from deepspeed_tpu.inference.serving.slots import make_draft_propose_fn
+    engine = _tiny_inference_engine()
+    N, S, K = 2, 32, 2
+    fn = make_draft_propose_fn(engine.module, None, K, S)
+    dcache = engine.module.init_cache(N, S, dtype=engine.compute_dtype)
+    args = (engine._params, dcache, _paged_state(N))
+    return EntryPoint("serving.spec_propose", fn, args,
+                      expect_donation=True)
+
+
+def serving_spec_verify():
+    """The speculative verify-and-commit program: ONE batched target
+    forward over [token, drafts], in-program accept mask + per-slot
+    accepted length, per-row MULTI-token scatter cache writes — target
+    cache AND slot state donated, no host callbacks (the whole point is
+    committing up to k+1 tokens per dispatch without a sync)."""
+    from deepspeed_tpu.inference.engine import build_sample_fn
+    from deepspeed_tpu.inference.serving.slots import make_spec_verify_fn
+    engine = _tiny_inference_engine()
+    N, S, K = 2, 32, 2
+    fn = make_spec_verify_fn(engine.module,
+                             build_sample_fn(False, 1.0, 0, 1.0),
+                             None, K, S)
+    cache = engine.module.init_cache(N, S, dtype=engine.compute_dtype)
+    draft = jnp.asarray(np.random.default_rng(6).integers(0, 97, (N, K)),
+                        jnp.int32)
+    args = (engine._params, cache, _paged_state(N), draft,
+            jax.random.key(0))
+    return EntryPoint("serving.spec_verify", fn, args,
+                      expect_donation=True)
+
+
+def serving_spec_verify_paged():
+    """The PAGED speculative verify program: pool + slot state donated,
+    page tables traced; inactive lanes' window writes redirect to the
+    trash page in-program, live lanes' per-row multi-token scatter
+    routes through the table."""
+    from deepspeed_tpu.inference.engine import build_sample_fn
+    from deepspeed_tpu.inference.serving.slots import \
+        make_paged_spec_verify_fn
+    engine = _tiny_inference_engine()
+    N, NP, PG, K = 2, 9, 8, 2
+    fn = make_paged_spec_verify_fn(engine.module,
+                                   build_sample_fn(False, 1.0, 0, 1.0),
+                                   None, K, 4 * PG)
+    pool = engine.module.init_paged_cache(NP, PG,
+                                          dtype=engine.compute_dtype)
+    pages = jnp.asarray([[3, 5, 2, 7], [1, 4, 0, 0]], jnp.int32)
+    draft = jnp.asarray(np.random.default_rng(7).integers(0, 97, (N, K)),
+                        jnp.int32)
+    args = (engine._params, pool, _paged_state(N), pages, draft,
+            jax.random.key(0))
+    return EntryPoint("serving.spec_verify_paged", fn, args,
+                      expect_donation=True)
+
+
+def serving_spec_draft_prefill():
+    """The draft-side admission-prefill chunk program (the draft cache
+    needs the prompt's K/V too): same body as the engine chunk program
+    bound to the draft module, draft lane donated."""
+    from deepspeed_tpu.inference.serving.slots import make_draft_chunk_fn
+    engine = _tiny_inference_engine()
+    C = 8
+    chunk_fn = make_draft_chunk_fn(engine.module, None)
+    lane = engine.module.init_cache(1, 32, dtype=engine.compute_dtype)
+    ids = jnp.asarray(np.random.default_rng(8).integers(0, 97, (1, C)),
+                      jnp.int32)
+    args = (engine._params, lane, ids, jnp.asarray(0, jnp.int32),
+            jnp.zeros((1,), jnp.int32))
+    return EntryPoint("serving.spec_draft_prefill", chunk_fn, args,
+                      expect_donation=True)
+
+
+def serving_spec_draft_admit():
+    """The draft-side admission insert: prefilled draft lane into the
+    draft cache over the traced slot index (draft cache donated); no
+    sampling, no state write — the target admit owns both."""
+    from deepspeed_tpu.inference.serving.slots import make_draft_admit_fn
+    engine = _tiny_inference_engine()
+    N, S = 2, 32
+    fn = make_draft_admit_fn()
+    dcache = engine.module.init_cache(N, S, dtype=engine.compute_dtype)
+    lane = engine.module.init_cache(1, S, dtype=engine.compute_dtype)
+    args = (dcache, lane, jnp.asarray(1, jnp.int32))
+    return EntryPoint("serving.spec_draft_admit", fn, args,
+                      expect_donation=True)
+
+
 def hybrid_rollout():
     """The hybrid engine's rollout generation program (RLHF: decode over
     the live training weights' inference view) — same jitted body as
@@ -311,7 +404,10 @@ BUILDERS = (runtime_train_step, runtime_apply_update, inference_decode,
             inference_prefill_chunk, serving_decode_step,
             serving_admission_prefill, serving_admit,
             serving_decode_step_paged, serving_admission_prefill_paged,
-            serving_admit_paged, hybrid_rollout)
+            serving_admit_paged, serving_spec_propose,
+            serving_spec_verify, serving_spec_verify_paged,
+            serving_spec_draft_prefill, serving_spec_draft_admit,
+            hybrid_rollout)
 
 
 def iter_entry_points():

@@ -80,6 +80,11 @@ def main():
     parser.add_argument("--ckpt-dir", required=True)
     parser.add_argument("--results", required=True)
     parser.add_argument("--drain-budget", type=float, default=0.0)
+    # speculative serving (docs/serving.md "Speculative decoding"):
+    # self-draft, k=2 — greedy outputs must stay BITWISE-identical to
+    # the non-speculative reference run, and a SIGTERM mid-speculation
+    # must snapshot committed tokens only
+    parser.add_argument("--spec", action="store_true")
     args = parser.parse_args()
 
     cfg = TransformerConfig(vocab_size=97, hidden_size=32, num_layers=2,
@@ -93,7 +98,9 @@ def main():
         "serving": {"enabled": True, "num_slots": 2, "max_cache_len": 64,
                     "prefill_chunk": 8, "prefill_token_budget": 16,
                     "decode_block": 2,
-                    "drain_budget_s": args.drain_budget},
+                    "drain_budget_s": args.drain_budget,
+                    **({"speculative": True, "spec_k": 2,
+                        "spec_draft_model": "self"} if args.spec else {})},
     }
     if _cache:
         config["compile_cache"] = {"enabled": True, "cache_dir": _cache,

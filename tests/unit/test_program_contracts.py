@@ -31,6 +31,11 @@ _COVERED = {
     "serving.decode_step_paged": "serving.decode_step_paged",
     "serving.prefill_chunk_paged": "serving.prefill_chunk_paged",
     "serving.admit_paged": "serving.admit_paged",
+    "serving.spec_propose": "serving.spec_propose",
+    "serving.spec_verify": "serving.spec_verify",
+    "serving.spec_verify_paged": "serving.spec_verify_paged",
+    "serving.spec_draft_prefill": "serving.spec_draft_prefill",
+    "serving.spec_draft_admit": "serving.spec_draft_admit",
     "hybrid.rollout_generate": "hybrid.rollout",
 }
 # host-side orchestrators / sub-programs of a locked contract: no single

@@ -486,7 +486,8 @@ def test_overload_drain_resume_zero_new_decode_executables(tmp_path):
 # The kill-at-seam acceptance proof (subprocess, every serving seam)
 # --------------------------------------------------------------------- #
 def _run_serving_driver(ckpt_dir, results_path, cache_dir,
-                        inject_spec=None, drain_budget=0.0):
+                        inject_spec=None, drain_budget=0.0,
+                        speculative=False):
     env = dict(os.environ)
     env["DSTPU_REPO_ROOT"] = REPO
     env["DSTPU_DRIVER_CACHE"] = str(cache_dir)
@@ -497,7 +498,8 @@ def _run_serving_driver(ckpt_dir, results_path, cache_dir,
     return subprocess.run(
         [sys.executable, DRIVER, "--ckpt-dir", str(ckpt_dir),
          "--results", str(results_path),
-         "--drain-budget", str(drain_budget)],
+         "--drain-budget", str(drain_budget)]
+        + (["--spec"] if speculative else []),
         env=env, capture_output=True, text=True, timeout=240)
 
 
@@ -527,39 +529,58 @@ def serving_driver_reference(tmp_path_factory):
     return {"cache": cache, "ref": ref, "base": base}
 
 
-# (scenario, DSTPU_FAULT_INJECT spec, expected first-run rc, drain budget)
+# (scenario, DSTPU_FAULT_INJECT spec, expected first-run rc, drain
+#  budget, speculative serving)
 SERVING_KILL_SCENARIOS = [
     # graceful: SIGTERM mid-serving -> drain -> snapshot -> exit 3
     ("sigterm_graceful",
-     "point=serving.sigterm_at_iter,action=sigterm,at=4", 3, 0.0),
+     "point=serving.sigterm_at_iter,action=sigterm,at=4", 3, 0.0, False),
     # hard kills (os._exit, no cleanup) at each dispatch seam
     ("exit_pre_admit",
-     "point=serving.pre_admit,action=exit,at=2", 17, 0.0),
+     "point=serving.pre_admit,action=exit,at=2", 17, 0.0, False),
     ("exit_pre_decode_dispatch",
-     "point=serving.pre_decode_dispatch,action=exit,at=3", 17, 0.0),
+     "point=serving.pre_decode_dispatch,action=exit,at=3", 17, 0.0,
+     False),
     # hard kill DURING the graceful drain, before the snapshot publishes
     ("exit_mid_drain",
      "point=serving.sigterm_at_iter,action=sigterm,at=5;"
-     "point=serving.mid_drain,action=exit,at=1", 17, 5.0),
+     "point=serving.mid_drain,action=exit,at=1", 17, 5.0, False),
+    # SPECULATIVE serving (self-draft, k=2): SIGTERM mid-speculation —
+    # the snapshot must hold committed tokens only (uncommitted draft
+    # tokens are discarded), the resumed SPECULATIVE run must merge
+    # bitwise with the NON-speculative reference (the bitwise-greedy
+    # contract and the kill harness, proven together)
+    ("sigterm_graceful_spec",
+     "point=serving.sigterm_at_iter,action=sigterm,at=4", 3, 0.0, True),
+    # hard kill at the decode seam mid-speculation: in-flight verify
+    # windows die unprocessed, nothing uncommitted may leak into results
+    ("exit_pre_decode_dispatch_spec",
+     "point=serving.pre_decode_dispatch,action=exit,at=3", 17, 0.0,
+     True),
 ]
 
 
-@pytest.mark.parametrize("name,spec,want_rc,budget",
+@pytest.mark.parametrize("name,spec,want_rc,budget,speculative",
                          SERVING_KILL_SCENARIOS,
                          ids=[s[0] for s in SERVING_KILL_SCENARIOS])
 def test_serving_kill_at_seam_resumes_bitwise(
-        name, spec, want_rc, budget, serving_driver_reference, tmp_path):
+        name, spec, want_rc, budget, speculative,
+        serving_driver_reference, tmp_path):
     """Acceptance: the serving driver killed at each serving seam —
     gracefully (SIGTERM -> drain -> crash-atomic snapshot) or hard
     (os._exit) — relaunches, resumes/resubmits, and every non-shed
     request completes with greedy outputs BITWISE-identical to the
     uninterrupted reference run; the deadline request reports
-    SHED_DEADLINE in every scenario."""
+    SHED_DEADLINE in every scenario.  The *_spec scenarios run the SAME
+    workload under speculative serving (self-draft) and must still
+    match the non-speculative reference bitwise — mid-speculation kills
+    may never surface uncommitted draft tokens."""
     ref = serving_driver_reference["ref"]
     cache = serving_driver_reference["cache"]
     results = tmp_path / "results.txt"
     proc = _run_serving_driver(tmp_path / "ckpt", results, cache,
-                               inject_spec=spec, drain_budget=budget)
+                               inject_spec=spec, drain_budget=budget,
+                               speculative=speculative)
     assert proc.returncode == want_rc, \
         f"{name}: expected rc={want_rc}, got {proc.returncode}\n" \
         + proc.stderr[-3000:] + proc.stdout[-1000:]
@@ -569,7 +590,8 @@ def test_serving_kill_at_seam_resumes_bitwise(
         assert tags, "preemption must leave a snapshot"
         assert verify_manifest(str(tmp_path / "ckpt" / tags[0])) == []
     proc = _run_serving_driver(tmp_path / "ckpt", results, cache,
-                               drain_budget=budget)
+                               drain_budget=budget,
+                               speculative=speculative)
     assert proc.returncode == 0, \
         f"{name}: resume failed\n" + proc.stderr[-3000:]
     got = _merged_results(results)

@@ -1,0 +1,451 @@
+"""Speculative multi-token decoding tests (``inference/serving/``,
+``docs/serving.md`` "Speculative decoding").
+
+The acceptance contract: with ``serving.speculative`` on, a draft model
+proposes ``spec_k`` tokens per live slot, the target verifies the whole
+window in ONE batched forward, and greedy outputs stay BITWISE-identical
+to non-speculative serving / solo ``generate()`` — through slot churn,
+mid-window EOS, paged mode, preempt→restore (committed tokens only ever
+reach snapshots and streams), with exactly one draft-propose and one
+verify-and-commit executable per server lifetime."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.serving.slo import RequestStatus
+from deepspeed_tpu.models.transformer import Transformer, TransformerConfig
+
+
+def tiny_cfg(**over):
+    base = dict(vocab_size=97, hidden_size=64, num_layers=2, num_heads=4,
+                max_seq_len=64, use_flash_attention=False, dtype="float32")
+    base.update(over)
+    return TransformerConfig(**base)
+
+
+SERVING = {"enabled": True, "num_slots": 3, "max_cache_len": 64,
+           "prefill_chunk": 8, "prefill_token_budget": 16,
+           "decode_block": 2}
+
+
+@pytest.fixture
+def served_engine():
+    model = Transformer(tiny_cfg())
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 97, (2, 12)),
+                      jnp.int32)
+    params = model.init(jax.random.key(0), {"input_ids": ids})
+    # prefill_chunk_size=8: the solo generate() reference replays the
+    # SAME split-prefill chunk program the serving admission path runs
+    eng = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32", "prefill_chunk_size": 8,
+                       "serving": SERVING})
+    eng.set_params(params)
+    return eng
+
+
+@pytest.fixture
+def draft_pair():
+    """A distinct, smaller random draft model sharing the target vocab —
+    the low-accept-rate end (correctness must not depend on the draft
+    being any good)."""
+    dcfg = tiny_cfg(hidden_size=32, num_layers=1)
+    draft = Transformer(dcfg)
+    ids = jnp.asarray(np.random.default_rng(1).integers(0, 97, (1, 8)),
+                      jnp.int32)
+    return draft, draft.init(jax.random.key(1), {"input_ids": ids})
+
+
+def _mixed_workload(rng, n=7):
+    lens = rng.integers(9, 21, (n,))
+    news = rng.integers(3, 13, (n,))
+    prompts = [rng.integers(1, 97, (int(p),)).astype(np.int32)
+               for p in lens]
+    return prompts, [int(x) for x in news]
+
+
+def _mid_stream_eos(eng, prompts, news, every=2):
+    """Per-request eos ids that actually fire mid-stream for every
+    ``every``-th request (probed from the greedy continuation)."""
+    eos_ids = []
+    for i, (p, n) in enumerate(zip(prompts, news)):
+        if i % every == 0:
+            probe = np.asarray(eng.generate(p[None], max_new_tokens=n))[0]
+            eos_ids.append(int(probe[len(p) + n // 2]))
+        else:
+            eos_ids.append(-1)
+    return eos_ids
+
+
+# --------------------------------------------------------------------- #
+# The bitwise-greedy acceptance contract
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("k", [1, 3])
+def test_spec_matches_solo_generate(served_engine, k):
+    """num_slots(3) < num_requests(7), mid-stream EOS on half the
+    requests, slot churn — greedy speculative outputs bitwise-equal to
+    solo generate(), for window sizes k=1 and k=3."""
+    eng = served_engine
+    rng = np.random.default_rng(3)
+    prompts, news = _mixed_workload(rng)
+    eos_ids = _mid_stream_eos(eng, prompts, news)
+
+    srv = eng.serve(speculative=True, spec_k=k, spec_draft_model="self")
+    rids = [srv.submit(p, max_new_tokens=n, eos_token_id=e)
+            for p, n, e in zip(prompts, news, eos_ids)]
+    outs = srv.drain()
+    assert sorted(outs) == sorted(rids)
+    for rid, p, n, e in zip(rids, prompts, news, eos_ids):
+        want = np.asarray(eng.generate(p[None], max_new_tokens=n,
+                                       eos_token_id=e))[0]
+        np.testing.assert_array_equal(
+            outs[rid], want,
+            err_msg=f"request {rid} (P={len(p)}, new={n}, eos={e}, "
+                    f"k={k}) diverges from its solo generate() run")
+    # slot churn really happened (EOS frees slots mid-flight)
+    occ = [o for _, o in srv.occupancy_trace]
+    assert any(occ[i] < occ[i - 1] for i in range(1, len(occ))), occ
+    assert srv.stats["completed"] == len(rids)
+    # self-draft greedy: the accept machinery actually accepted drafts
+    assert srv.stats["spec_committed_tokens"] > srv.stats["spec_windows"]
+
+
+def test_spec_matches_nonspec_serving(served_engine):
+    """Speculative serving outputs == NON-speculative serving outputs,
+    bitwise, on the same workload (the tentpole claim verbatim)."""
+    eng = served_engine
+    rng = np.random.default_rng(11)
+    prompts, news = _mixed_workload(rng, n=5)
+    eos_ids = _mid_stream_eos(eng, prompts, news)
+
+    base = eng.serve()
+    b_rids = [base.submit(p, max_new_tokens=n, eos_token_id=e)
+              for p, n, e in zip(prompts, news, eos_ids)]
+    b_outs = base.drain()
+    base.close()
+    spec = eng.serve(speculative=True, spec_k=4, spec_draft_model="self")
+    s_rids = [spec.submit(p, max_new_tokens=n, eos_token_id=e)
+              for p, n, e in zip(prompts, news, eos_ids)]
+    s_outs = spec.drain()
+    for br, sr in zip(b_rids, s_rids):
+        np.testing.assert_array_equal(b_outs[br], s_outs[sr])
+    # and speculation needed FEWER target dispatches than non-spec
+    # decode rounds would commit: each spec round commits up to k+1
+    # per slot vs decode_block(=2) for the baseline config
+    assert spec.stats["spec_tokens_per_dispatch"] > 1.0
+
+
+def test_spec_random_draft_still_bitwise(served_engine, draft_pair):
+    """A terrible (random) draft model must only cost THROUGHPUT, never
+    correctness: accept rate ~0, outputs still bitwise-equal to solo."""
+    eng = served_engine
+    draft, dparams = draft_pair
+    rng = np.random.default_rng(13)
+    prompts, news = _mixed_workload(rng, n=4)
+    srv = eng.serve(speculative=True, spec_k=2, draft_module=draft,
+                    draft_params=dparams)
+    rids = [srv.submit(p, max_new_tokens=n)
+            for p, n in zip(prompts, news)]
+    outs = srv.drain()
+    for rid, p, n in zip(rids, prompts, news):
+        want = np.asarray(eng.generate(p[None], max_new_tokens=n))[0]
+        np.testing.assert_array_equal(outs[rid], want)
+    assert srv.stats["spec_accept_rate"] < 0.5
+
+
+def test_spec_paged_matches_solo(served_engine):
+    """Paged pool + speculation: the verify window's per-row multi-token
+    writes route through the page tables; outputs bitwise vs solo with
+    slot churn and mid-stream EOS.  (Prefix sharing is disabled under
+    speculation — the draft cache prefills from position 0.)"""
+    eng = served_engine
+    rng = np.random.default_rng(17)
+    prompts, news = _mixed_workload(rng, n=6)
+    eos_ids = _mid_stream_eos(eng, prompts, news)
+    srv = eng.serve(speculative=True, spec_k=2, spec_draft_model="self",
+                    paged=True, page_size=16)
+    assert srv.stats["prefix_lookups"] == 0
+    rids = [srv.submit(p, max_new_tokens=n, eos_token_id=e)
+            for p, n, e in zip(prompts, news, eos_ids)]
+    outs = srv.drain()
+    for rid, p, n, e in zip(rids, prompts, news, eos_ids):
+        want = np.asarray(eng.generate(p[None], max_new_tokens=n,
+                                       eos_token_id=e))[0]
+        np.testing.assert_array_equal(outs[rid], want)
+    assert srv.stats["prefix_lookups"] == 0      # disabled under spec
+
+
+# --------------------------------------------------------------------- #
+# TokenStream: a dispatch committing m tokens emits m ORDERED events
+# --------------------------------------------------------------------- #
+def test_spec_stream_emits_per_token_events(served_engine):
+    """Multi-token commits must stream as individual ordered per-token
+    events (monotonic indices, lossless replay) — including a request
+    whose EOS lands mid-speculation-window, whose stream must end at
+    exactly the terminal token."""
+    eng = served_engine
+    rng = np.random.default_rng(19)
+    prompts, news = _mixed_workload(rng, n=3)
+    news = [max(n, 8) for n in news]
+    eos_ids = _mid_stream_eos(eng, prompts, news, every=1)
+    eos_ids[1] = -1                       # one request without EOS
+    srv = eng.serve(speculative=True, spec_k=3, spec_draft_model="self")
+    rids = [srv.submit(p, max_new_tokens=n, eos_token_id=e)
+            for p, n, e in zip(prompts, news, eos_ids)]
+    streams = {rid: srv.token_events(rid) for rid in rids}
+    outs = srv.drain()
+    for rid, p, n, e in zip(rids, prompts, news, eos_ids):
+        toks, end = streams[rid].tokens(timeout=5)
+        res = srv.result(rid)
+        # stream == the generated region of the final result, bitwise
+        gen = [int(t) for t in outs[rid][len(p):len(p) + len(toks)]]
+        assert toks == gen, (rid, toks, gen)
+        assert end["status"] == RequestStatus.COMPLETED
+        # per-token: more events than dispatches for this rid, indices
+        # contiguous from 0 (TokenStream replays + live pushes agree)
+        assert len(toks) >= 1
+        if e >= 0:
+            # EOS mid-window: the stream ends AT the eos token — nothing
+            # past it was ever surfaced
+            assert toks[-1] == e
+            assert e not in toks[:-1]
+    # late subscription replays losslessly after completion
+    replay, end = srv.token_events(rids[0]).tokens(timeout=1)
+    first, _ = streams[rids[0]].rid, None
+    want = [int(t) for t in
+            outs[rids[0]][len(prompts[0]):len(prompts[0]) + len(replay)]]
+    assert replay == want and end["status"] == RequestStatus.COMPLETED
+
+
+def test_spec_stream_event_indices_monotonic(served_engine):
+    """The raw event dicts carry strictly increasing ``index`` values
+    starting at 0 — one event per committed token, never a blob per
+    dispatch."""
+    eng = served_engine
+    rng = np.random.default_rng(23)
+    p = rng.integers(1, 97, (10,)).astype(np.int32)
+    srv = eng.serve(speculative=True, spec_k=4, spec_draft_model="self")
+    rid = srv.submit(p, max_new_tokens=12)
+    stream = srv.token_events(rid)
+    srv.drain()
+    events = list(stream.events(timeout=5))
+    tok_events = [ev for ev in events if ev["event"] == "token"]
+    assert [ev["index"] for ev in tok_events] == \
+        list(range(len(tok_events)))
+    assert events[-1]["event"] == "end"
+    # at least one dispatch committed more than one token (self-draft
+    # greedy accepts) — the per-token contract did real work here
+    assert srv.stats["spec_tokens_per_dispatch"] > 1.0
+
+
+# --------------------------------------------------------------------- #
+# Preempt / restore: committed tokens only, bitwise resume
+# --------------------------------------------------------------------- #
+def test_spec_preempt_restore_bitwise(served_engine, tmp_path):
+    """preempt() mid-speculation snapshots COMMITTED tokens only (every
+    snapshotted token list is a prefix of the final output; uncommitted
+    draft tokens are never surfaced) and a restarted speculative server
+    resumes bitwise.  Draft state is re-derived through the ordinary
+    re-prefill path — nothing draft-side is snapshotted."""
+    eng = served_engine
+    rng = np.random.default_rng(29)
+    prompts, _ = _mixed_workload(rng, n=3)
+    srv = eng.serve(speculative=True, spec_k=3, spec_draft_model="self")
+    rids = [srv.submit(p, max_new_tokens=14) for p in prompts]
+    for _ in range(4):
+        srv.step()
+    tag, snapped, finished = srv.preempt(str(tmp_path), drain_budget_s=0.0)
+    assert snapped, "nothing was mid-flight — the test lost its point"
+    state = json.loads(
+        (tmp_path / tag / "serving_state.json").read_text())
+    assert not any("draft" in k for k in state), \
+        "draft state must be re-derived on restore, never snapshotted"
+
+    srv2 = eng.serve(speculative=True, spec_k=3, spec_draft_model="self")
+    restored = srv2.restore(str(tmp_path))
+    assert sorted(restored) == sorted(snapped)
+    outs = dict(finished)
+    outs.update(srv2.drain())
+    for rid, p in zip(rids, prompts):
+        want = np.asarray(eng.generate(p[None], max_new_tokens=14))[0]
+        np.testing.assert_array_equal(outs[rid], want)
+    # committed-only: each snapshotted token list is a PREFIX of the
+    # final generated region
+    by_rid = {int(r["rid"]): r for r in state["requests"]}
+    for rid, p in zip(rids, prompts):
+        if rid not in by_rid:
+            continue
+        snap_toks = [int(t) for t in by_rid[rid]["tokens"]]
+        gen = [int(t) for t in outs[rid][len(p):]]
+        assert snap_toks == gen[:len(snap_toks)], (snap_toks, gen)
+
+
+# --------------------------------------------------------------------- #
+# One draft + one verify executable per server lifetime
+# --------------------------------------------------------------------- #
+def test_spec_zero_new_executables_across_churn_and_resume(tmp_path):
+    """Overload + shed + cancel + preempt + restarted-server resume mint
+    exactly ONE draft-propose and ONE verify-and-commit executable per
+    server lifetime, with zero executable-store traffic (the serving
+    programs bypass the persistent caches)."""
+    from deepspeed_tpu.runtime import compile_cache as cc
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        model = Transformer(tiny_cfg())
+        ids = jnp.asarray(
+            np.random.default_rng(0).integers(0, 97, (1, 12)), jnp.int32)
+        params = model.init(jax.random.key(0), {"input_ids": ids})
+        config = {"dtype": "float32", "prefill_chunk_size": 8,
+                  "serving": {**SERVING, "speculative": True, "spec_k": 2,
+                              "spec_draft_model": "self"},
+                  "compile_cache": {"enabled": True,
+                                    "cache_dir": str(tmp_path / "cache"),
+                                    "min_compile_time_secs": 0.0}}
+        snap = str(tmp_path / "snap")
+        rng = np.random.default_rng(57)
+        prompts, news = _mixed_workload(rng, n=7)
+
+        def fresh_server():
+            eng = deepspeed_tpu.init_inference(model, config=config)
+            eng.set_params(params)
+            srv = eng.serve()
+            return eng, srv, srv.warmup()
+
+        eng1, srv1, report1 = fresh_server()
+        assert any(k.startswith("serving_spec_verify") for k in report1)
+        assert any(k.startswith("serving_spec_propose") for k in report1)
+        rids = [srv1.submit(p, max_new_tokens=n)
+                for p, n in zip(prompts[:5], news[:5])]
+        r_shed = srv1.submit(prompts[5], max_new_tokens=4, deadline_s=0.0)
+        r_cancel = srv1.submit(prompts[6], max_new_tokens=4)
+        srv1.cancel(r_cancel)
+        early = {}
+        for _ in range(4):
+            early.update(srv1.step())
+        s1 = cc.stats().snapshot()
+        tag, snapped, finished = srv1.preempt(snap, drain_budget_s=0.0)
+        finished = {**early, **finished}
+        assert srv1.result(r_shed).status == RequestStatus.SHED_DEADLINE
+
+        eng2, srv2, report2 = fresh_server()
+        s2 = cc.stats().snapshot()
+        assert s2["executable_saves"] == s1["executable_saves"]
+        assert s2["executable_hits"] == s1["executable_hits"]
+        restored = srv2.restore(snap)
+        assert sorted(restored) == sorted(snapped)
+        outs = dict(finished)
+        outs.update(srv2.drain())
+        s3 = cc.stats().snapshot()
+        assert s3["executable_saves"] == s1["executable_saves"], \
+            "the spec overload+resume cycle persisted a new executable"
+        for srv, eng in ((srv1, eng1), (srv2, eng2)):
+            for fn, what in ((srv._propose_fn, "draft-propose"),
+                             (srv._verify_fn, "verify-and-commit")):
+                n_sig = sum(1 for sig in eng._aot
+                            if sig and sig[0] == id(fn))
+                assert n_sig == 1, (what, n_sig)
+        for rid, p, n in zip(rids, prompts[:5], news[:5]):
+            want = np.asarray(
+                eng2.generate(p[None], max_new_tokens=n))[0]
+            np.testing.assert_array_equal(outs[rid], want)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev_min)
+        cc._configured_dir = prev_dir
+
+
+# --------------------------------------------------------------------- #
+# Validation, capacity reserve, observability, registry
+# --------------------------------------------------------------------- #
+def test_spec_validation(served_engine, draft_pair):
+    eng = served_engine
+    draft, dparams = draft_pair
+    with pytest.raises(ValueError, match="greedy"):
+        eng.serve(speculative=True, spec_draft_model="self",
+                  do_sample=True)
+    with pytest.raises(ValueError, match="draft model"):
+        eng.serve(speculative=True)
+    with pytest.raises(ValueError, match="draft_params"):
+        eng.serve(speculative=True, draft_module=draft)
+    with pytest.raises(ValueError, match="spec_k"):
+        eng.serve(speculative=True, spec_draft_model="self", spec_k=0)
+    bad = Transformer(tiny_cfg(vocab_size=96, hidden_size=32))
+    bad_params = bad.init(jax.random.key(2),
+                          {"input_ids": jnp.zeros((1, 8), jnp.int32)})
+    with pytest.raises(ValueError, match="vocab"):
+        eng.serve(speculative=True, draft_module=bad,
+                  draft_params=bad_params)
+
+
+def test_spec_window_capacity_reserve(served_engine):
+    """Each lane reserves spec_k-1 tail positions for the verify
+    window's writes: a request that exactly fills the lane in non-spec
+    mode must be REJECTED under speculation with a clear reason."""
+    eng = served_engine
+    p = np.ones((40,), np.int32)
+    base = eng.serve()
+    base.submit(p, max_new_tokens=24)           # 40+24 = 64: fits
+    base.close()
+    srv = eng.serve(speculative=True, spec_k=4, spec_draft_model="self")
+    with pytest.raises(ValueError, match="speculative window reserve"):
+        srv.submit(p, max_new_tokens=24)        # 40+24+3 > 64
+    rid = srv.submit(p, max_new_tokens=21)      # 40+21+3 = 64: fits
+    out = srv.drain()[rid]
+    want = np.asarray(eng.generate(p[None], max_new_tokens=21))[0]
+    np.testing.assert_array_equal(out, want)
+
+
+def test_spec_observability_and_registry(served_engine):
+    """Monitor events, stats keys and the concurrency registry cover the
+    speculative path: Serving/spec_* events emitted, spec_* stats keys
+    live (→ dstpu_serving_spec_* gauges via the /metrics stats sweep),
+    and the draft-mirror fields declared in GUARDED_FIELDS exist on a
+    speculative engine."""
+    from deepspeed_tpu.inference.serving.concurrency import GUARDED_FIELDS
+
+    class FakeMonitor:
+        enabled = True
+
+        def __init__(self):
+            self.events = []
+
+        def write_events(self, evs):
+            self.events.extend(evs)
+
+    eng = served_engine
+    mon = FakeMonitor()
+    srv = eng.serve(monitor=mon, speculative=True, spec_k=2,
+                    spec_draft_model="self")
+    for field in ("_draft_cache", "_draft_lanes"):
+        assert field in GUARDED_FIELDS["ServingEngine"]
+        assert hasattr(srv, field), field
+    rng = np.random.default_rng(31)
+    prompts, news = _mixed_workload(rng, n=4)
+    for p, n in zip(prompts, news):
+        srv.submit(p, max_new_tokens=n)
+    srv.drain()
+    names = {n for n, _, _ in mon.events}
+    for want in ("Serving/spec_accept_rate",
+                 "Serving/spec_tokens_per_dispatch",
+                 "Serving/spec_draft_fraction"):
+        assert want in names, names
+    for key in ("spec_rounds", "spec_windows", "spec_committed_tokens",
+                "spec_accept_rate", "spec_tokens_per_dispatch",
+                "spec_draft_secs", "spec_verify_secs",
+                "spec_draft_fraction"):
+        assert key in srv.stats, key
+    assert srv.stats["spec_rounds"] > 0
+    assert 0.0 <= srv.stats["spec_accept_rate"] <= 1.0
+    assert srv.stats["spec_draft_secs"] > 0.0
+    rates = [v for n, v, _ in mon.events
+             if n == "Serving/spec_accept_rate"]
+    assert rates and all(0.0 <= v <= 1.0 for v in rates)

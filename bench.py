@@ -57,6 +57,7 @@ record.
 in-process instead (old behavior).
 """
 
+import datetime
 import json
 import os
 import subprocess
@@ -1707,6 +1708,13 @@ def _out_dir():
     return d
 
 
+def _utc_now():
+    """ISO-8601 UTC timestamp for per-phase forensics (the r05 blackout
+    could not even be ORDERED from the record)."""
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+
+
 def _spawn_phase(name, fallback, timeout_s, extra_env):
     # pid-suffixed: two bench parents must not share phase scratch files
     out_path = os.path.join(_out_dir(),
@@ -1846,7 +1854,8 @@ def main():
     name = "startup"
     try:
         for key, name, _ in phases:
-            budget = int(timeout_s * PHASE_TIMEOUT_SCALE.get(name, 1.0))
+            budget = uncapped = int(timeout_s
+                                    * PHASE_TIMEOUT_SCALE.get(name, 1.0))
             if suite_budget:
                 # the round-5 lesson, part two: the budget was only
                 # checked BETWEEN phases, so one phase could blow straight
@@ -1856,9 +1865,20 @@ def main():
                 # outright when the remainder is not worth a phase
                 remaining = suite_budget - (time.perf_counter() - suite_t0)
                 if remaining - 30 < 60:
-                    result[key] = {"skipped": f"suite budget "
-                                              f"({suite_budget:.0f}s) "
-                                              f"exhausted"}
+                    # r05-blackout forensics: the record must say WHY a
+                    # phase is missing (budget math at the decision
+                    # point), not just that it is
+                    result[key] = {
+                        "skipped": f"suite budget "
+                                   f"({suite_budget:.0f}s) exhausted",
+                        "skipped_reason":
+                            f"suite budget {suite_budget:.0f}s exhausted "
+                            f"with {remaining:.0f}s remaining (< 90s "
+                            f"floor incl. the 30s record-flush reserve)",
+                        "started_at": _utc_now(),
+                        "elapsed_s": 0.0,
+                        "timeout_budget_s": 0,
+                    }
                     print(f"bench: suite budget exhausted — skipping {name}",
                           file=sys.stderr)
                     _write_record(partial_path, result)
@@ -1866,6 +1886,7 @@ def main():
                                   _assemble_final(result, errors))
                     continue
                 budget = min(budget, int(remaining - 30))
+            started_at = _utc_now()
             phase, err, wall = _spawn_phase(name, False, budget, extra_env)
             timed_out = phase is None and err and err.startswith("timeout")
             if phase is None and timed_out \
@@ -1875,7 +1896,11 @@ def main():
                 # (crashes still get the fallback retry below: a safe
                 # config fixes an OOM, it does not fix slowness)
                 errors[name] = err
-                phase = {"error": err, "timeout": True}
+                phase = {"error": err, "timeout": True,
+                         "skipped_reason": f"timed out after {budget}s "
+                                           f"(BENCH_PHASE_TIMEOUT "
+                                           f"x {PHASE_TIMEOUT_SCALE.get(name, 1.0)}"
+                                           f"{', capped by suite budget' if budget < uncapped else ''})"}
                 print(f"bench: phase {name} exceeded its {budget}s budget — "
                       f"recording the overrun and continuing",
                       file=sys.stderr)
@@ -1894,7 +1919,13 @@ def main():
                     phase = {"error": err}
                     print(f"bench: phase {name} failed twice — recording "
                           f"the error and continuing", file=sys.stderr)
+            # per-phase forensics in EVERY record (the r05 lesson: a
+            # missing phase with no started_at/budget context is
+            # undiagnosable from the record alone)
             phase["phase_wall_s"] = round(wall, 1)
+            phase["started_at"] = started_at
+            phase["elapsed_s"] = round(wall, 1)
+            phase["timeout_budget_s"] = budget
             _annotate_regressions(key, phase, trail=trail)
             if key == "calibration" and "measured_mxu_tflops" in phase:
                 # anchor later phases' roofline math to the measured peaks —

@@ -96,7 +96,9 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # off = current whole-batch generate() behavior.  The block also
     # carries the serving SLO knobs (deadlines, bounded-queue
     # backpressure, circuit breaker, drain timeout/budget — the
-    # "Robustness & SLOs" section of docs/serving.md)
+    # "Robustness & SLOs" section of docs/serving.md) and the
+    # observability knobs (span tracing, flight recorder, histogram
+    # metrics, profile endpoint — docs/observability.md)
     serving: ServingConfig = Field(default_factory=ServingConfig)
     # decode loop form: True (default) runs the generation decode loop as
     # a bounded lax.while_loop that stops once every row hit EOS (short

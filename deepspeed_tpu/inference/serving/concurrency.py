@@ -26,6 +26,13 @@ lock-guarded — and three independent checkers consume the declaration:
 Deliberately NOT in the registry (each with its reason):
 
 * ``wake`` — a ``threading.Event``, internally synchronized.
+* ``_flightrec`` — the flight recorder's ring has its OWN lock
+  (``flightrec.py``): readers (``/debug/flightrec``, SIGUSR2, crash
+  dumps) must never contend the engine lock, and the reference is set
+  once in ``__init__``.
+* ``_hist`` — the serving histograms are internally locked per bucket
+  set (``monitor/trace.py``): the /metrics scrape renders them without
+  the engine lock.
 * ``_breaker`` — mutated only under the lock; its unlocked reads are
   single-attribute monitoring probes with no compound invariant.
 * ``_lock`` / ``_cond`` — the guards themselves.
@@ -86,6 +93,9 @@ GUARDED_FIELDS = {
         "_fairness": "_lock",
         "stats": "_lock",
         "occupancy_trace": "_lock",
+        # observability: the span tracer's ring is appended to at the
+        # scheduler seams (lock-held) and copied whole by dump_trace()
+        "_tracer": "_lock",
     },
 }
 
@@ -132,6 +142,22 @@ def _checked_class(base):
     def _assert_held(self, name, verb):
         lock = object.__getattribute__(self, guarded[name])
         if not lock._is_owned():
+            # last-gasp observability: the flight recorder (own lock —
+            # safe to touch here) captures the violation and dumps the
+            # ring, so the post-mortem shows what the scheduler was
+            # doing when the discipline broke.  Strictly best-effort:
+            # the violation must raise regardless.
+            try:
+                fr = object.__getattribute__(self, "_flightrec")
+            except AttributeError:
+                fr = None
+            if fr is not None:
+                try:
+                    fr.record("concurrency_violation", field=name,
+                              verb=verb)
+                    fr.dump("concurrency_violation")
+                except Exception:        # noqa: BLE001
+                    pass
             raise ConcurrencyViolation(
                 f"{verb} of lock-guarded field {name!r} from thread "
                 f"{threading.current_thread().name!r} without holding "
@@ -200,6 +226,13 @@ class InstrumentedRLock:
         self.acquires = {"scheduler": 0, "handler": 0}
         self.samples = {"scheduler": deque(maxlen=self.SAMPLE_WINDOW),
                         "handler": deque(maxlen=self.SAMPLE_WINDOW)}
+        # optional per-acquire observer ``(thread_class, wait_s) -> None``
+        # — the serving engine points it at its lock-wait histogram
+        # under ``serving.tracing``.  Called lock-HELD (right after a
+        # successful acquire) and must be internally synchronized and
+        # non-raising; exceptions are swallowed so a broken observer
+        # can never poison the lock.
+        self.on_wait = None
 
     def _account(self, dt):
         cls = ("scheduler"
@@ -208,6 +241,12 @@ class InstrumentedRLock:
         self.wait_s[cls] += dt
         self.acquires[cls] += 1
         self.samples[cls].append(dt)
+        cb = self.on_wait
+        if cb is not None:
+            try:
+                cb(cls, dt)
+            except Exception:            # noqa: BLE001 — observer only
+                pass
 
     def acquire(self, blocking=True, timeout=-1):
         if self._inner._is_owned():

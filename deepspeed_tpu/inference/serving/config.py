@@ -141,3 +141,35 @@ class ServingConfig(DeepSpeedConfigModel):
     # in-flight slots for up to this many seconds before snapshotting
     # the remainder; 0 = snapshot immediately, no drain
     drain_budget_s: float = 30.0
+    # ---- observability (docs/observability.md) — every default = seed
+    # behavior: zero spans, zero histograms, zero ring events ----
+    # per-request span tracing: record a span tree per request (submit ->
+    # queue wait -> prefill chunks -> admit -> decode/spec dispatches ->
+    # terminal) at the existing scheduler seams, export Chrome
+    # trace-event JSON via srv.dump_trace(path) (Perfetto: one track per
+    # slot + scheduler/queue tracks), attach a queue/prefill/decode/host
+    # latency breakdown to every RequestResult, and feed the
+    # TTFT/TBT/queue-wait/dispatch/lock-wait histograms /metrics
+    # exposes.  Host-side only: no new jitted programs, greedy outputs
+    # bitwise-identical either way
+    tracing: bool = False
+    # span-ring bound (oldest spans fall off; the dump records how many
+    # were dropped)
+    trace_max_spans: int = 100000
+    # flight recorder: a bounded ring of recent structured scheduler
+    # events (dispatch begin/end, admit/shed/cancel/abort decisions,
+    # breaker transitions, lock-wait samples, fault-injection hits)
+    # that auto-dumps to JSON on breaker-open, DrainTimeout,
+    # ConcurrencyViolation and scheduler-thread death, and on demand via
+    # GET /debug/flightrec, SIGUSR2 or srv.dump_flightrec().  The ring
+    # has its OWN lock — readers never contend the engine lock
+    flight_recorder: bool = False
+    # ring capacity in events (memory is bounded; ~300 bytes/event)
+    flight_recorder_events: int = 2048
+    # auto-dump directory; "" = <tmpdir>/dstpu_flightrec
+    flight_recorder_dir: str = ""
+    # on-demand device-level profiling: POST /debug/profile?secs=N runs
+    # jax.profiler for N seconds and returns the trace directory
+    # (Perfetto/TensorBoard-loadable).  Off by default: profiling is a
+    # debug affordance, not a production endpoint
+    profile_endpoint: bool = False

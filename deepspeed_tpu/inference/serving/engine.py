@@ -85,12 +85,35 @@ through the crash-atomic checkpoint protocol, so a restarted server
 an uninterrupted run.  All of it is host bookkeeping riding the existing
 traced slot arguments — no new program shapes, the one-decode-executable
 invariant holds through overload, drain and resume.
+
+**Observability layer** (``docs/observability.md``): with
+``serving.tracing`` on, every request carries a span tree (submit →
+queue wait → admission prefill chunks → admit dispatch → decode /
+spec-propose / spec-verify dispatches with tokens-committed counts →
+terminal), recorded host-side at the existing scheduler seams,
+exportable as Chrome trace-event JSON (:meth:`ServingEngine.dump_trace`,
+Perfetto-loadable, one track per slot plus scheduler/queue/handler
+tracks) and summarized as a queue/prefill/decode/host latency breakdown
+on every :class:`~.slo.RequestResult`; TTFT, time-between-tokens,
+queue-wait, per-program dispatch-duration and lock-wait histograms feed
+``/metrics``.  With ``serving.flight_recorder`` on, a bounded
+self-locked ring of recent structured events (dispatch begin/end,
+scheduler decisions, breaker transitions, shed/cancel/abort reasons,
+lock-wait samples, fault-injection hits) auto-dumps to JSON on
+breaker-open, ``DrainTimeout``, ``ConcurrencyViolation`` and
+scheduler-thread death, and on demand via ``GET /debug/flightrec``,
+SIGUSR2 or :meth:`ServingEngine.dump_flightrec`.  Both are default-off
+= seed behavior, host-side only (zero new jitted programs — the
+zero-new-executables proof covers the tracing-on path), and the hot
+path never contends a reader: the ring and the histograms carry their
+own locks.
 """
 
 import math
 import threading
 import time
 from collections import deque
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -102,6 +125,8 @@ import jax.numpy as jnp
 from deepspeed_tpu.inference.serving.concurrency import (
     InstrumentedRLock, checks_enabled, install_concurrency_checks)
 from deepspeed_tpu.inference.serving.config import ServingConfig
+from deepspeed_tpu.inference.serving.flightrec import FlightRecorder
+from deepspeed_tpu.monitor.trace import ServingHistograms, SpanTracer
 from deepspeed_tpu.inference.serving.paging import (PagePool,
                                                     PagedPoolWorkspace,
                                                     PrefixIndex,
@@ -159,6 +184,18 @@ class ServeRequest:
     priority: int = 0
     streamed: int = 0
     resumed: bool = False            # restored from a preempt snapshot
+    # observability stamps (serving.tracing only; the tracer's clock, so
+    # tests can inject a deterministic one) — the request's span-tree
+    # boundaries: submit -> admission start -> admit dispatched ->
+    # first token processed -> terminal; t_last_tok drives the
+    # time-between-tokens histogram and is stamped ONCE per token at
+    # the host-mirror drain (a TokenStream late-attach replay never
+    # re-stamps it)
+    t_trace: Optional[float] = None
+    t_admit_start: Optional[float] = None
+    t_prefill_done: Optional[float] = None
+    t_first_tok: Optional[float] = None
+    t_last_tok: Optional[float] = None
 
     @property
     def fill_ids(self):
@@ -540,6 +577,38 @@ class ServingEngine:
                 "spec_draft_secs": 0.0, "spec_verify_secs": 0.0,
                 "spec_draft_fraction": 0.0})
         self.occupancy_trace = []        # (it, n_active)  # guarded-by: _lock
+        # ---- observability layer (docs/observability.md): span tracer
+        # + histograms + flight recorder.  All default-off = seed
+        # behavior; all host-side (zero new jitted programs — the
+        # zero-new-executables proof covers the tracing-on path too).
+        self.tracing = bool(cfg.tracing)
+        if self.tracing:
+            self._tracer = SpanTracer(int(cfg.trace_max_spans))  # guarded-by: _lock
+            # histograms carry their own per-bucket locks (the /metrics
+            # scrape renders them WITHOUT the engine lock); the
+            # InstrumentedRLock observer feeds per-acquire lock waits
+            # straight into the lock-wait family
+            self._hist = ServingHistograms()
+            self._lock.on_wait = self._hist.lock_wait.observe
+        else:
+            self._tracer = None          # guarded-by: _lock
+            self._hist = None
+        self._inject_observer = None
+        if cfg.flight_recorder:
+            # the ring is guarded by its OWN lock (flightrec.py): the
+            # hot path appends without contending readers, and crash
+            # paths (/debug/flightrec, SIGUSR2, ConcurrencyViolation)
+            # read without the engine lock
+            self._flightrec = FlightRecorder(
+                int(cfg.flight_recorder_events),
+                dump_dir=cfg.flight_recorder_dir or None)
+            fr = self._flightrec
+            self._inject_observer = inject.add_fire_observer(
+                lambda point, action, hit: fr.record(
+                    "fault_injection", point=point, action=action,
+                    hit=hit))
+        else:
+            self._flightrec = None
         # classify lock waiters as scheduler vs handler; the ref is read
         # AFTER a successful acquire, i.e. lock-held (concurrency.py)
         self._lock._owner_ref = \
@@ -617,6 +686,165 @@ class ServingEngine:
                 if jnp.issubdtype(p.dtype, jnp.floating) else p, t),
             out_shardings=NamedSharding(engine.mesh, PartitionSpec()))
         return draft_module, put(draft_params)
+
+    # ------------------------------------------------------------------ #
+    # Observability: span tracing, flight recorder, histograms
+    # (docs/observability.md) — host bookkeeping only, all default-off
+    # ------------------------------------------------------------------ #
+    @contextmanager
+    def _observe_dispatch(self, program, **args):  # lock-held: _lock
+        """Record one device dispatch at its scheduler seam: a span on
+        the scheduler track + a dispatch-duration histogram sample
+        (tracing) and a ``dispatch_begin``/``dispatch_end`` (or
+        ``dispatch_error``) event pair (flight recorder).  The measured
+        duration is the HOST dispatch call — the async-dispatch cost the
+        latency-hiding protocol is built around — never a device sync.
+        No-op passthrough when both are off."""
+        tr, fr = self._tracer, self._flightrec
+        if tr is None and fr is None:
+            yield
+            return
+        t0 = tr.now() if tr is not None else time.monotonic()
+        if fr is not None:
+            fr.record("dispatch_begin", program=program, **args)
+        try:
+            yield
+        except BaseException as e:
+            if fr is not None:
+                fr.record("dispatch_error", program=program,
+                          error=f"{type(e).__name__}: {e}"[:200], **args)
+            raise
+        t1 = tr.now() if tr is not None else time.monotonic()
+        if tr is not None:
+            tr.add(program, "dispatch", t0, t1, track="scheduler", **args)
+            self._hist.dispatch.observe(program, t1 - t0)
+        if fr is not None:
+            fr.record("dispatch_end", program=program,
+                      dur_s=round(t1 - t0, 6), **args)
+
+    def _trace_done(self, req, status):  # lock-held: _lock
+        """Terminal-time tracing: compute the request's latency
+        breakdown (the :class:`~.slo.RequestResult` fields — segments
+        between the stamped span boundaries, the LAST reached phase
+        absorbing the remainder, so the parts always sum to
+        ``latency_s`` exactly) and emit its span tree onto its slot
+        track (requests that never reached a slot land on the ``queue``
+        track).  Returns ``{}`` with tracing off."""
+        tr = self._tracer
+        if tr is None or req.t_trace is None:
+            return {}
+        t_end = tr.now()
+        t_sub = req.t_trace
+        bd = {"latency_s": max(t_end - t_sub, 0.0)}
+        prev = t_sub
+        for name, nxt in (("queue_s", req.t_admit_start),
+                          ("prefill_s", req.t_prefill_done),
+                          ("host_s", req.t_first_tok),
+                          ("decode_s", t_end)):
+            if nxt is None:              # ended mid-phase: absorb rest
+                bd[name] = max(t_end - prev, 0.0)
+                break
+            bd[name] = max(nxt - prev, 0.0)
+            prev = nxt
+        track = req.slot if req.slot is not None else "queue"
+        cid = None if req.client_id is None else str(req.client_id)
+        tr.add("request", "request", t_sub, t_end, track=track,
+               rid=req.rid, client_id=cid, slot=req.slot,
+               priority=req.priority, status=status,
+               tokens=len(req.tokens))
+        tr.add("queue", "phase", t_sub,
+               t_end if req.t_admit_start is None else req.t_admit_start,
+               track=track, rid=req.rid, phase="queue")
+        if req.t_admit_start is not None:
+            tr.add("prefill", "phase", req.t_admit_start,
+                   t_end if req.t_prefill_done is None
+                   else req.t_prefill_done,
+                   track=track, rid=req.rid, phase="prefill")
+        if req.t_first_tok is not None:
+            tr.add("decode", "phase", req.t_first_tok, t_end,
+                   track=track, rid=req.rid, phase="decode",
+                   tokens=len(req.tokens))
+        return bd
+
+    def _flight_dump(self, reason):
+        """Best-effort auto-dump: a failing dump must never mask the
+        distress being recorded.  Returns the dump path or ``None``."""
+        fr = self._flightrec
+        if fr is None:
+            return None
+        try:
+            path = fr.dump(reason)
+            logger.warning(f"serving flight recorder dumped to {path} "
+                           f"({reason})")
+            return path
+        except Exception as e:           # noqa: BLE001
+            logger.warning(f"serving flight-recorder dump failed "
+                           f"({reason}): {type(e).__name__}: {e}")
+            return None
+
+    def _detach_observability(self):  # lock-held: _lock
+        """Engine retirement (close/preempt): unhook the process-global
+        fault-injection observer and flush the monitor so short-lived
+        serving processes never drop tail events."""
+        if self._inject_observer is not None:
+            inject.remove_fire_observer(self._inject_observer)
+            self._inject_observer = None
+        mon = self.monitor
+        flush = getattr(mon, "flush", None)
+        if callable(flush):
+            try:
+                flush()
+            except Exception as e:       # noqa: BLE001
+                logger.warning(f"serving monitor flush on retirement "
+                               f"failed: {type(e).__name__}: {e}")
+
+    def dump_trace(self, path):
+        """Write the span ring as Chrome trace-event JSON to ``path``
+        (Perfetto / ``chrome://tracing`` loadable: one track per slot
+        plus scheduler/queue/handler tracks; ``docs/observability.md``).
+        Raises with ``serving.tracing`` off.  Thread-safe — only the
+        ring COPY happens under the engine lock; rendering and writing
+        (tens of MB on a full ring) run outside it, so a live
+        scheduler is never stalled for the serialization."""
+        with self._lock:
+            if self._tracer is None:
+                raise RuntimeError(
+                    "dump_trace(): serving.tracing is off — enable it "
+                    "to record spans (docs/observability.md)")
+            tracer = self._tracer
+            snap = tracer.span_snapshot()    # (spans, added), lock-held
+        return tracer.dump(path, spans=snap)
+
+    def histograms(self):
+        """The :class:`~deepspeed_tpu.monitor.trace.ServingHistograms`
+        set (``None`` with ``serving.tracing`` off).  Internally locked
+        — ``/metrics`` renders it without the engine lock."""
+        return self._hist
+
+    @property
+    def flightrec_enabled(self):
+        """Cheap enabled predicate — use this for gating, not
+        :meth:`flightrec_snapshot` (which copies the whole ring)."""
+        return self._flightrec is not None
+
+    def flightrec_snapshot(self):
+        """Point-in-time copy of the flight-recorder ring (``None``
+        when ``serving.flight_recorder`` is off).  Never takes the
+        engine lock."""
+        fr = self._flightrec
+        return None if fr is None else fr.snapshot()
+
+    def dump_flightrec(self, reason="manual", path=None):
+        """Dump the flight-recorder ring to a JSON file (default: under
+        ``serving.flight_recorder_dir``); returns the path.  Raises
+        with ``serving.flight_recorder`` off.  Never takes the engine
+        lock — callable from signal handlers and crash paths."""
+        fr = self._flightrec
+        if fr is None:
+            raise RuntimeError(
+                "dump_flightrec(): serving.flight_recorder is off — "
+                "enable it to record events (docs/observability.md)")
+        return fr.dump(reason, path=path)
 
     # ------------------------------------------------------------------ #
     # Public API
@@ -709,6 +937,11 @@ class ServingEngine:
         self._breaker.check_submit()         # reject-with-reason when open
         if self._fairness is not None and not self._fairness.allow(client_id):
             self.stats["fairness_rejected"] += 1
+            if self._flightrec is not None:
+                self._flightrec.record(
+                    "fairness_reject",
+                    client_id=None if client_id is None
+                    else str(client_id))
             raise QueueFull(
                 f"client {client_id!r} is over its fairness budget "
                 f"({self._fairness.usage(client_id):.0f} window tokens "
@@ -727,6 +960,20 @@ class ServingEngine:
         self._next_rid += 1
         self._queue.append(req)
         self._requests[req.rid] = req
+        if self._tracer is not None:
+            # the span-tree root's start; submissions arrive on client
+            # threads, so the instant marker lands on the handler track
+            req.t_trace = self._tracer.now()
+            self._tracer.add("submit", "request", req.t_trace,
+                             track="handler", rid=req.rid,
+                             priority=priority,
+                             client_id=None if client_id is None
+                             else str(client_id))
+        if self._flightrec is not None:
+            self._flightrec.record(
+                "submit", rid=req.rid, prompt_len=P, max_new=max_new,
+                priority=priority,
+                client_id=None if client_id is None else str(client_id))
         return req.rid
 
     def _apply_backpressure(self):  # lock-held: _lock
@@ -966,7 +1213,11 @@ class ServingEngine:
         self._results[req.rid] = RequestResult(
             rid=req.rid, status=status, output=None, detail=detail,
             client_id=req.client_id, submitted_it=req.submitted_it,
-            finished_it=self._it, ttft_s=ttft)
+            finished_it=self._it, ttft_s=ttft,
+            **self._trace_done(req, status))
+        if self._flightrec is not None:
+            self._flightrec.record("terminal", rid=req.rid,
+                                   status=status, detail=detail[:200])
         self._pending_reports[req.rid] = None
         # result is recorded BEFORE the end event: a subscriber woken by
         # "end" can immediately read result(rid)
@@ -1087,6 +1338,7 @@ class ServingEngine:
         if self._closed:
             raise RuntimeError("step() on a closed ServingEngine")
         t0 = time.perf_counter()
+        t0_tr = self._tracer.now() if self._tracer is not None else None
         inject.fire("serving.sigterm_at_iter")
         self._ensure_workspace()
         finished = {}
@@ -1097,6 +1349,7 @@ class ServingEngine:
             # ABORTED results) and counted; `threshold` consecutive ones
             # open the breaker — no dispatches until the cooldown's
             # half-open probe, and submit() rejects with the reason
+            was_open = self._breaker.open
             dispatched = False
             try:
                 if self._breaker.allow_dispatch():
@@ -1104,12 +1357,29 @@ class ServingEngine:
                     dispatched = self._dispatch_decode()
             except Exception as e:
                 self._breaker.record_failure(e)
+                if self._flightrec is not None:
+                    self._flightrec.record(
+                        "breaker_failure",
+                        consecutive=self._breaker.consecutive_failures,
+                        threshold=self._breaker.threshold,
+                        error=f"{type(e).__name__}: {e}"[:200])
+                    if self._breaker.open and not was_open:
+                        # the moment the server stops trusting its own
+                        # device: capture what led here
+                        self._flightrec.record(
+                            "breaker_open", trips=self._breaker.trips,
+                            last_error=self._breaker.last_error[:200])
+                        self._flight_dump("breaker_open")
                 logger.warning(
                     f"serving dispatch failure absorbed by the circuit "
                     f"breaker ({self._breaker.consecutive_failures}"
                     f"/{self._breaker.threshold} consecutive"
                     f"{'; OPEN' if self._breaker.open else ''}): "
                     f"{type(e).__name__}: {e}")
+            if was_open and not self._breaker.open \
+                    and self._flightrec is not None:
+                self._flightrec.record("breaker_close",
+                                       trips=self._breaker.trips)
         else:
             self._admit()
             dispatched = self._dispatch_decode()
@@ -1122,9 +1392,21 @@ class ServingEngine:
         # (InstrumentedRLock; exported via /metrics and Serving/ events)
         self.stats["lock_wait_scheduler_s"] = self._lock.wait_s["scheduler"]
         self.stats["lock_wait_handler_s"] = self._lock.wait_s["handler"]
+        if self._flightrec is not None \
+                and self.stats["iterations"] % 32 == 0:
+            # periodic lock-wait sample: cheap cumulative snapshot so a
+            # dump shows whether contention grew before the distress
+            self._flightrec.record(
+                "lock_wait",
+                scheduler_s=round(self.stats["lock_wait_scheduler_s"], 6),
+                handler_s=round(self.stats["lock_wait_handler_s"], 6))
         self._emit_metrics()
         self.stats["iterations"] += 1
         self.stats["wall_secs"] += time.perf_counter() - t0
+        if self._tracer is not None:
+            self._tracer.add("step", "scheduler", t0_tr,
+                             self._tracer.now(), track="scheduler",
+                             it=self._it)
         self._it += 1
         if self._pending_reports:
             finished.update(self._pending_reports)
@@ -1155,6 +1437,13 @@ class ServingEngine:
                 with self._lock:
                     diag = self._drain_diagnostics(timeout,
                                                    time.monotonic() - t0)
+                if self._flightrec is not None:
+                    # the dump's tail is the dispatch sequence that led
+                    # into the wedge — what the diagnostics (a
+                    # point-in-time view) cannot show
+                    self._flightrec.record("drain_timeout",
+                                           diag=diag[:400])
+                    self._flight_dump("drain_timeout")
                 raise DrainTimeout(diag)
             if self._breaker.open and not self._breaker.allow_dispatch() \
                     and not self._anything_in_flight():
@@ -1268,6 +1557,7 @@ class ServingEngine:
         self._release_draft_workspaces()
         if self.paged:
             self._pool_ws.release()
+        self._detach_observability()
         self._closed = True
         self._close_report = undrained
         # blocked submit()s must observe _closed and raise, idle
@@ -1317,6 +1607,9 @@ class ServingEngine:
         self._paging_reset()
         if lost:
             self.stats["aborted"] = self.stats.get("aborted", 0) + len(lost)
+            if self._flightrec is not None:
+                self._flightrec.record("abort_in_flight", why=why[:200],
+                                       rids=lost)
             logger.warning(f"serving {why}: aborted {len(lost)} in-flight "
                            f"request(s) {lost} — queued requests survive")
 
@@ -1558,7 +1851,22 @@ class ServingEngine:
                     # free pages (backpressure, never a partial grab)
                     self._queue.appendleft(req)
                     self.stats["admission_stalls"] += 1
+                    if self._flightrec is not None:
+                        self._flightrec.record(
+                            "admission_stall", rid=req.rid,
+                            pool_in_use=self._pool.in_use
+                            if self.paged else None)
                     return
+                if self._tracer is not None and req.t_trace is not None:
+                    # queue phase ends here: admission decided, the slot
+                    # is reserved and prefill chunks start streaming
+                    req.t_admit_start = self._tracer.now()
+                    self._hist.queue_wait.observe(
+                        req.t_admit_start - req.t_trace)
+                if self._flightrec is not None:
+                    self._flightrec.record(
+                        "admit_start", rid=req.rid, slot=req.slot,
+                        fill_len=pend.fill_len, chunks=pend.n_chunks)
                 if self._fairness is not None and not req.resumed:
                     # charge admitted prefill work once, when admission
                     # actually starts (a paged stall above retries the
@@ -1676,24 +1984,30 @@ class ServingEngine:
         # (ci+1)*C); start > 0 only for paged shared-prefix admissions
         local = int(min(max(P - 1 - p.start - p.ci * C, 0), C - 1))
         try:
-            if self.paged:
-                # the chunk writes straight into the slot's pool pages —
-                # the POOL is the donated buffer, chained with decode
-                row = jnp.asarray(
-                    self._page_table[p.slot:p.slot + 1])
-                logits, self._cache = self.engine._run_guarded(
-                    self._chunk_fn,
-                    (self.engine._params, self._cache, row,
-                     jnp.asarray(p.ids_pad[:, p.ci * C:(p.ci + 1) * C]),
-                     jnp.asarray(p.start + p.ci * C, jnp.int32),
-                     jnp.asarray([local], jnp.int32)))
-            else:
-                logits, p.lane = self.engine._run_guarded(
-                    self._chunk_fn,
-                    (self.engine._params, p.lane,
-                     jnp.asarray(p.ids_pad[:, p.ci * C:(p.ci + 1) * C]),
-                     jnp.asarray(p.ci * C, jnp.int32),
-                     jnp.asarray([local], jnp.int32)))
+            with self._observe_dispatch("prefill_chunk", rid=p.req.rid,
+                                        slot=p.slot, chunk=p.ci,
+                                        phase="prefill"):
+                if self.paged:
+                    # the chunk writes straight into the slot's pool
+                    # pages — the POOL is the donated buffer, chained
+                    # with decode
+                    row = jnp.asarray(
+                        self._page_table[p.slot:p.slot + 1])
+                    logits, self._cache = self.engine._run_guarded(
+                        self._chunk_fn,
+                        (self.engine._params, self._cache, row,
+                         jnp.asarray(
+                             p.ids_pad[:, p.ci * C:(p.ci + 1) * C]),
+                         jnp.asarray(p.start + p.ci * C, jnp.int32),
+                         jnp.asarray([local], jnp.int32)))
+                else:
+                    logits, p.lane = self.engine._run_guarded(
+                        self._chunk_fn,
+                        (self.engine._params, p.lane,
+                         jnp.asarray(
+                             p.ids_pad[:, p.ci * C:(p.ci + 1) * C]),
+                         jnp.asarray(p.ci * C, jnp.int32),
+                         jnp.asarray([local], jnp.int32)))
         except BaseException as e:
             if self.paged:
                 # the donated POOL may be dead — this is a decode-grade
@@ -1730,12 +2044,16 @@ class ServingEngine:
             # speculation, p.start is always 0)
             t0s = time.perf_counter()
             try:
-                _, p.draft_lane = self.engine._run_guarded(
-                    self._draft_chunk_fn,
-                    (self._draft_params, p.draft_lane,
-                     jnp.asarray(p.ids_pad[:, p.ci * C:(p.ci + 1) * C]),
-                     jnp.asarray(p.start + p.ci * C, jnp.int32),
-                     jnp.asarray([local], jnp.int32)))
+                with self._observe_dispatch("draft_prefill_chunk",
+                                            rid=p.req.rid, slot=p.slot,
+                                            chunk=p.ci, phase="prefill"):
+                    _, p.draft_lane = self.engine._run_guarded(
+                        self._draft_chunk_fn,
+                        (self._draft_params, p.draft_lane,
+                         jnp.asarray(
+                             p.ids_pad[:, p.ci * C:(p.ci + 1) * C]),
+                         jnp.asarray(p.start + p.ci * C, jnp.int32),
+                         jnp.asarray([local], jnp.int32)))
             except BaseException as e:
                 # the donated draft lane may be dead — drop only THIS
                 # admission.  The target side's partial writes are freed
@@ -1778,26 +2096,29 @@ class ServingEngine:
         self._rng, sub = jax.random.split(self._rng)
         try:
             inject.fire("serving.pre_admit")
-            if self.paged:
-                # the prompt's K/V already sits in the slot's pages —
-                # paged admission is just the first-token sample + the
-                # in-program slot-state write (state donated)
-                self._state, first = self.engine._run_guarded(
-                    self._admit_fn,
-                    (self._state, p.sel, sub,
-                     jnp.asarray(p.slot, jnp.int32),
-                     jnp.asarray(p.fill_len, jnp.int32),
-                     jnp.asarray(dev_new, jnp.int32),
-                     jnp.asarray(req.eos, jnp.int32)))
-            else:
-                self._cache, self._state, first = \
-                    self.engine._run_guarded(
+            with self._observe_dispatch("admit", rid=req.rid,
+                                        slot=int(p.slot),
+                                        phase="admit"):
+                if self.paged:
+                    # the prompt's K/V already sits in the slot's pages
+                    # — paged admission is just the first-token sample +
+                    # the in-program slot-state write (state donated)
+                    self._state, first = self.engine._run_guarded(
                         self._admit_fn,
-                        (self._cache, self._state, p.lane, p.sel, sub,
+                        (self._state, p.sel, sub,
                          jnp.asarray(p.slot, jnp.int32),
                          jnp.asarray(p.fill_len, jnp.int32),
                          jnp.asarray(dev_new, jnp.int32),
                          jnp.asarray(req.eos, jnp.int32)))
+                else:
+                    self._cache, self._state, first = \
+                        self.engine._run_guarded(
+                            self._admit_fn,
+                            (self._cache, self._state, p.lane, p.sel, sub,
+                             jnp.asarray(p.slot, jnp.int32),
+                             jnp.asarray(p.fill_len, jnp.int32),
+                             jnp.asarray(dev_new, jnp.int32),
+                             jnp.asarray(req.eos, jnp.int32)))
         except BaseException as e:
             # cache/state were donated — same recovery as a decode
             # failure (this admission's request is lost with them).
@@ -1830,10 +2151,13 @@ class ServingEngine:
             # draft-side twin of the target admit's lane insert)
             t0s = time.perf_counter()
             try:
-                self._draft_cache = self.engine._run_guarded(
-                    self._draft_admit_fn,
-                    (self._draft_cache, p.draft_lane,
-                     jnp.asarray(p.slot, jnp.int32)))
+                with self._observe_dispatch("draft_admit", rid=req.rid,
+                                            slot=int(p.slot),
+                                            phase="admit"):
+                    self._draft_cache = self.engine._run_guarded(
+                        self._draft_admit_fn,
+                        (self._draft_cache, p.draft_lane,
+                         jnp.asarray(p.slot, jnp.int32)))
             except BaseException as e:
                 # the donated draft cache may be dead — decode-grade
                 # failure: every live slot's draft K/V lived in it
@@ -1853,6 +2177,11 @@ class ServingEngine:
         self._events.append(("admit", req, p.slot, p.lane, first,
                              p.draft_lane))
         self.stats["admitted"] += 1
+        if self._tracer is not None and req.t_admit_start is not None:
+            # prefill phase ends: the fused admit is dispatched; what
+            # follows until the first token is PROCESSED is the lag-one
+            # host window the breakdown books as host_s
+            req.t_prefill_done = self._tracer.now()
 
     # ------------------------------------------------------------------ #
     # Decode: one block of the single reusable decode-step program
@@ -1868,16 +2197,23 @@ class ServingEngine:
             inject.fire("serving.pre_decode_dispatch")
             if self.speculative:
                 ev = self._dispatch_spec(sub)
-            elif self.paged:
-                toks, self._cache, self._state = self.engine._run_guarded(
-                    self._decode_fn,
-                    (self.engine._params, self._cache, self._state,
-                     jnp.asarray(self._page_table), sub))
-                ev = ("decode", toks)
             else:
-                toks, self._cache, self._state = self.engine._run_guarded(
-                    self._decode_fn,
-                    (self.engine._params, self._cache, self._state, sub))
+                with self._observe_dispatch(
+                        "decode", phase="decode",
+                        live_slots=int(self._mirror_active.sum())):
+                    if self.paged:
+                        toks, self._cache, self._state = \
+                            self.engine._run_guarded(
+                                self._decode_fn,
+                                (self.engine._params, self._cache,
+                                 self._state,
+                                 jnp.asarray(self._page_table), sub))
+                    else:
+                        toks, self._cache, self._state = \
+                            self.engine._run_guarded(
+                                self._decode_fn,
+                                (self.engine._params, self._cache,
+                                 self._state, sub))
                 ev = ("decode", toks)
         except BaseException:
             # the donated cache/state may be dead — drop them so the next
@@ -1911,24 +2247,29 @@ class ServingEngine:
         propose → verify as a device array.  A failure in either
         dispatch is handled by the caller's decode-failure recovery
         (``_abort_in_flight`` drops the draft cache too)."""
+        live = int(self._mirror_active.sum())
         t0 = time.perf_counter()
-        draft, self._draft_cache = self.engine._run_guarded(
-            self._propose_fn,
-            (self._draft_params, self._draft_cache, self._state))
+        with self._observe_dispatch("spec_propose", phase="decode",
+                                    live_slots=live):
+            draft, self._draft_cache = self.engine._run_guarded(
+                self._propose_fn,
+                (self._draft_params, self._draft_cache, self._state))
         t1 = time.perf_counter()
         self.stats["spec_draft_secs"] += t1 - t0
-        if self.paged:
-            toks, accepted, self._cache, self._state = \
-                self.engine._run_guarded(
-                    self._verify_fn,
-                    (self.engine._params, self._cache, self._state,
-                     jnp.asarray(self._page_table), draft, sub))
-        else:
-            toks, accepted, self._cache, self._state = \
-                self.engine._run_guarded(
-                    self._verify_fn,
-                    (self.engine._params, self._cache, self._state,
-                     draft, sub))
+        with self._observe_dispatch("spec_verify", phase="decode",
+                                    live_slots=live):
+            if self.paged:
+                toks, accepted, self._cache, self._state = \
+                    self.engine._run_guarded(
+                        self._verify_fn,
+                        (self.engine._params, self._cache, self._state,
+                         jnp.asarray(self._page_table), draft, sub))
+            else:
+                toks, accepted, self._cache, self._state = \
+                    self.engine._run_guarded(
+                        self._verify_fn,
+                        (self.engine._params, self._cache, self._state,
+                         draft, sub))
         self.stats["spec_verify_secs"] += time.perf_counter() - t1
         return ("spec", toks, accepted)
 
@@ -1965,6 +2306,14 @@ class ServingEngine:
             return
         if req.first_tok_t is None:
             req.first_tok_t = time.monotonic()
+        if self._tracer is not None and req.t_first_tok is None \
+                and req.t_trace is not None:
+            # the first token is PROCESSED here (the host-mirror drain
+            # point, one event behind the device) — TTFT is stamped
+            # exactly once, on the tracer's clock; TokenStream replays
+            # re-read req.tokens, they never come back through here
+            req.t_first_tok = req.t_last_tok = self._tracer.now()
+            self._hist.ttft.observe(req.t_first_tok - req.t_trace)
         req.tokens = list(req.prefix) + [first]
         if self._fairness is not None:
             # the sampled first token; prefill tokens (incl. any resumed
@@ -1992,6 +2341,13 @@ class ServingEngine:
         True when the slot retired."""
         req.tokens.append(tok)
         self.stats["decode_tokens"] += 1
+        if self._tracer is not None and req.t_trace is not None:
+            # time-between-tokens at the drain point, stamped once per
+            # token — late-attached stream replays never re-stamp
+            now = self._tracer.now()
+            if req.t_last_tok is not None:
+                self._hist.tbt.observe(now - req.t_last_tok)
+            req.t_last_tok = now
         if self._fairness is not None:
             self._fairness.charge(req.client_id, 1)
         if (req.eos >= 0 and tok == req.eos) \
@@ -2006,6 +2362,8 @@ class ServingEngine:
         return False
 
     def _process_decode(self, ev, finished):  # lock-held: _lock
+        t0c = self._tracer.now() if self._tracer is not None else None
+        n0 = self.stats["decode_tokens"]
         t0 = time.perf_counter()
         toks = np.asarray(ev[1])                         # [block, N]
         self.stats["sync_secs"] += time.perf_counter() - t0
@@ -2016,6 +2374,14 @@ class ServingEngine:
             for s in np.nonzero(self._mirror_active)[0]:
                 req = self._slots[s]
                 self._mirror_commit_token(s, req, int(row[s]), finished)
+        committed = self.stats["decode_tokens"] - n0
+        if self._tracer is not None:
+            self._tracer.add("commit", "mirror", t0c, self._tracer.now(),
+                             track="scheduler", kind="decode",
+                             tokens=committed)
+        if self._flightrec is not None:
+            self._flightrec.record("commit", kind="decode",
+                                   tokens=committed)
         self.occupancy_trace.append(
             (self._it, int(self._mirror_active.sum())))
 
@@ -2031,6 +2397,8 @@ class ServingEngine:
         dispatch — and mid-window retirement cuts the stream exactly at
         the terminal token."""
         _, toks_dev, acc_dev = ev
+        t0c = self._tracer.now() if self._tracer is not None else None
+        n0 = self.stats["spec_committed_tokens"]
         t0 = time.perf_counter()
         toks = np.asarray(toks_dev)                      # [spec_k+1, N]
         acc = np.asarray(acc_dev)                        # [N]
@@ -2058,6 +2426,14 @@ class ServingEngine:
         d, v = self.stats["spec_draft_secs"], self.stats["spec_verify_secs"]
         if d + v > 0:
             self.stats["spec_draft_fraction"] = d / (d + v)
+        committed = self.stats["spec_committed_tokens"] - n0
+        if self._tracer is not None:
+            self._tracer.add("commit", "mirror", t0c, self._tracer.now(),
+                             track="scheduler", kind="spec",
+                             tokens=committed)
+        if self._flightrec is not None:
+            self._flightrec.record("commit", kind="spec",
+                                   tokens=committed)
         self.occupancy_trace.append(
             (self._it, int(self._mirror_active.sum())))
 
@@ -2079,7 +2455,12 @@ class ServingEngine:
         self._results[req.rid] = RequestResult(
             rid=req.rid, status=RequestStatus.COMPLETED, output=out,
             client_id=req.client_id, submitted_it=req.submitted_it,
-            finished_it=self._it, ttft_s=ttft)
+            finished_it=self._it, ttft_s=ttft,
+            **self._trace_done(req, RequestStatus.COMPLETED))
+        if self._flightrec is not None:
+            self._flightrec.record("terminal", rid=req.rid,
+                                   status=RequestStatus.COMPLETED,
+                                   tokens=len(req.tokens))
         self._publish_end(req, RequestStatus.COMPLETED)
         return out
 
@@ -2184,6 +2565,7 @@ class ServingEngine:
         self._paging_reset()
         if self.paged:
             self._pool_ws.release()
+        self._detach_observability()
         self._closed = True
         self._close_report = sorted(snapped)
         self._cond.notify_all()
@@ -2375,6 +2757,9 @@ class ServingEngine:
                     f"({len(prefix)} tokens) does not fit its lane "
                     f"chunk-padded — re-decoding from the prompt")
                 req.prefix = []
+            if self._tracer is not None:
+                # the resumed incarnation's span tree starts at restore
+                req.t_trace = self._tracer.now()
             self._queue.append(req)
             self._requests[req.rid] = req
             self._next_rid = max(self._next_rid, req.rid + 1)

@@ -30,6 +30,20 @@ Endpoints
   closed/preempted).
 - ``GET /metrics`` — Prometheus text (``dstpu_serving_*``) from the
   engine's monitor counters, plus per-client fairness window balances.
+  Every series carries ``# HELP``/``# TYPE`` lines and label values are
+  fully escaped (``\\``, ``"``, newline) — the exposition round-trips
+  through the text-format parser the tests ship.  With
+  ``serving.tracing`` on, the TTFT / time-between-tokens / queue-wait /
+  per-program dispatch-duration / lock-wait histograms are exported too
+  (``docs/observability.md``).
+- ``GET /debug/flightrec`` — the flight-recorder ring as JSON (``404``
+  unless ``serving.flight_recorder`` is on).  SIGUSR2 (when signal
+  handlers are installed) dumps the same ring to a file without
+  touching the engine lock.
+- ``POST /debug/profile?secs=N`` — on-demand ``jax.profiler`` capture
+  for device-level traces (``404`` unless ``serving.profile_endpoint``;
+  ``409`` while another capture runs); responds with the trace
+  directory.
 
 Error mapping: over-quota / full queue → ``429`` (:class:`QueueFull`),
 open circuit breaker / closed engine → ``503``, malformed request →
@@ -124,6 +138,7 @@ class ServingHTTPFrontend:
         self._sched_thread = None
         self._stop = threading.Event()
         self._preempt = threading.Event()
+        self._profile_lock = threading.Lock()   # one capture at a time
         self._sched_error = None
         self.preempt_result = None       # (tag, rids, finished) after SIGTERM
         self._t0 = time.monotonic()
@@ -204,10 +219,31 @@ class ServingHTTPFrontend:
     def install_signal_handlers(self, signals=(signal.SIGTERM,)):
         """Route SIGTERM to :meth:`request_preempt` (main thread only —
         CPython restricts ``signal.signal``).  Previous handlers are
-        restored by :meth:`shutdown`."""
+        restored by :meth:`shutdown`.  When the engine carries a flight
+        recorder, SIGUSR2 additionally dumps its ring to a file
+        (``docs/observability.md`` — the recorder never takes the
+        engine lock, so the dump is safe from a signal frame)."""
         for sig in signals:
             self._prev_handlers[sig] = signal.signal(
                 sig, lambda *_: self.request_preempt())
+        if getattr(self.srv, "flightrec_enabled", False):
+            self.install_flightrec_signal_handler()
+
+    def install_flightrec_signal_handler(self, sig=None):
+        """Route SIGUSR2 (or ``sig``) to a flight-recorder dump.  Main
+        thread only; restored by :meth:`shutdown`."""
+        sig = sig if sig is not None else signal.SIGUSR2
+        self._prev_handlers[sig] = signal.signal(
+            sig, lambda *_: self._dump_flightrec_signal())
+
+    def _dump_flightrec_signal(self):
+        try:
+            path = self.srv.dump_flightrec(reason="sigusr2")
+            logger.warning(f"[serving] SIGUSR2: flight recorder dumped "
+                           f"to {path}")
+        except Exception as e:           # noqa: BLE001 — signal frame
+            logger.warning(f"[serving] SIGUSR2 flight-recorder dump "
+                           f"failed: {type(e).__name__}: {e}")
 
     def _scheduler_loop(self):
         """The single scheduler owner: drives ``step()`` while work is
@@ -241,6 +277,15 @@ class ServingHTTPFrontend:
             self._sched_error = f"{type(e).__name__}: {e}"
             logger.error(f"[serving] scheduler thread died: "
                          f"{self._sched_error}")
+            # a dead scheduler is exactly what the flight recorder
+            # exists for: dump the ring BEFORE close() clears the scene
+            try:
+                if getattr(srv, "flightrec_enabled", False):
+                    srv._flightrec.record("scheduler_thread_death",
+                                          error=self._sched_error[:200])
+                    srv.dump_flightrec(reason="scheduler_thread_death")
+            except Exception:            # noqa: BLE001 — best effort
+                pass
             # nothing will drive the engine again: close it so every
             # in-flight request ends with a typed ABORTED event (waiting
             # handlers unblock) and new submits get 503 instead of
@@ -411,7 +456,8 @@ class ServingHTTPFrontend:
         return True
 
     async def _route(self, req, writer):
-        method, path = req["method"], req["path"].split("?", 1)[0]
+        method = req["method"]
+        path, _, query = req["path"].partition("?")
         try:
             if path == "/v1/generate" and method == "POST":
                 return await self._generate(req, writer)
@@ -419,6 +465,10 @@ class ServingHTTPFrontend:
                 return await self._healthz(writer)
             if path == "/metrics" and method == "GET":
                 return await self._metrics(writer)
+            if path == "/debug/flightrec" and method == "GET":
+                return await self._debug_flightrec(writer)
+            if path == "/debug/profile" and method == "POST":
+                return await self._debug_profile(query, writer)
             if path.startswith("/v1/requests/"):
                 return await self._request_resource(method, path, writer)
             return await self._respond(
@@ -608,11 +658,26 @@ class ServingHTTPFrontend:
         return await self._respond(
             writer, 503 if snap["closed"] else 200, payload)
 
+    @staticmethod
+    def _esc_label(v):
+        """Prometheus text-format label-value escaping: backslash,
+        double quote and newline (exposition-format spec)."""
+        return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+    @staticmethod
+    def _esc_help(v):
+        """HELP-line escaping: backslash and newline."""
+        return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
     def _metrics_body(self):
         """Render the Prometheus text (runs in an executor thread; the
         snapshot is taken under the engine lock — the scheduler thread
         grows ``stats`` and the fairness tracker compacts its window
-        map in place, so an unlocked iteration can race both)."""
+        map in place, so an unlocked iteration can race both).  Every
+        series carries ``# HELP``/``# TYPE``; label values are escaped;
+        the round-trip test parses the full output back
+        (``tests/unit/test_serving_trace.py``)."""
         srv = self.srv
         with srv._lock:
             stats = dict(srv.stats)
@@ -629,13 +694,28 @@ class ServingHTTPFrontend:
                 "fairness_budget": None if srv._fairness is None
                 else srv._fairness.budget,
             }
+        hist = srv.histograms()          # internally locked; may be None
         lines = []
 
-        def gauge(name, value, help_=None, labels=""):
-            if help_:
-                lines.append(f"# HELP dstpu_serving_{name} {help_}")
-                lines.append(f"# TYPE dstpu_serving_{name} gauge")
-            lines.append(f"dstpu_serving_{name}{labels} {float(value)}")
+        def series(name, help_, type_, samples):
+            """One metric family: HELP/TYPE exactly once, then every
+            sample — ``samples`` is ``[(suffix, labels_dict, value)]``
+            (suffix: ``""`` for gauges, ``_bucket``/``_sum``/``_count``
+            for histograms)."""
+            lines.append(f"# HELP {name} {self._esc_help(help_)}")
+            lines.append(f"# TYPE {name} {type_}")
+            for suffix, labels, value in samples:
+                lab = ""
+                if labels:
+                    inner = ",".join(
+                        f'{k}="{self._esc_label(v)}"'
+                        for k, v in labels.items())
+                    lab = "{" + inner + "}"
+                lines.append(f"{name}{suffix}{lab} {float(value)}")
+
+        def gauge(name, value, help_, labels=None):
+            series(f"dstpu_serving_{name}", help_, "gauge",
+                   [("", labels or {}, value)])
 
         for key, val in sorted(stats.items()):
             gauge(key, val, help_=f"serving engine counter {key!r}")
@@ -651,28 +731,26 @@ class ServingHTTPFrontend:
               "dispatch circuit breaker state")
         gauge("uptime_seconds", time.monotonic() - self._t0,
               "front-end uptime")
-        lines.append("# HELP dstpu_serving_lock_wait_seconds cumulative "
-                     "wall time waiting on the engine lock per thread "
-                     "class")
-        lines.append("# TYPE dstpu_serving_lock_wait_seconds gauge")
-        for cls in sorted(lock_wait):
-            lines.append(f'dstpu_serving_lock_wait_seconds'
-                         f'{{thread_class="{cls}"}} '
-                         f'{float(lock_wait[cls])}')
+        series("dstpu_serving_lock_wait_seconds",
+               "cumulative wall time waiting on the engine lock per "
+               "thread class", "gauge",
+               [("", {"thread_class": cls}, lock_wait[cls])
+                for cls in sorted(lock_wait)])
         if snap["paged_util"] is not None:
             gauge("page_pool_utilization", snap["paged_util"],
                   "allocated fraction of the KV page pool")
         if snap["fairness"] is not None:
-            lines.append("# HELP dstpu_serving_fairness_window_tokens "
-                         "per-client decayed window balance")
-            lines.append("# TYPE dstpu_serving_fairness_window_tokens "
-                         "gauge")
-            for key, bal in snap["fairness"]:
-                esc = key.replace("\\", "\\\\").replace('"', '\\"')
-                lines.append(f'dstpu_serving_fairness_window_tokens'
-                             f'{{client="{esc}"}} {bal}')
+            series("dstpu_serving_fairness_window_tokens",
+                   "per-client decayed window balance", "gauge",
+                   [("", {"client": key}, bal)
+                    for key, bal in snap["fairness"]])
             gauge("fairness_budget", snap["fairness_budget"],
                   "window budget above which submit() is 429'd")
+        if hist is not None:
+            # serving.tracing: the TTFT / TBT / queue-wait / dispatch /
+            # lock-wait histograms (docs/observability.md)
+            for name, help_, samples in hist.collect():
+                series(name, help_, "histogram", samples)
         return ("\n".join(lines) + "\n").encode()
 
     async def _metrics(self, writer):
@@ -681,6 +759,63 @@ class ServingHTTPFrontend:
         return await self._respond(
             writer, 200, body,
             ctype="text/plain; version=0.0.4; charset=utf-8")
+
+    # ------------------------------------------------------------------ #
+    # /debug/flightrec and /debug/profile (docs/observability.md)
+    # ------------------------------------------------------------------ #
+    async def _debug_flightrec(self, writer):
+        """The flight-recorder ring as JSON.  The snapshot never takes
+        the engine lock (the ring is self-locked), but it copies up to
+        ``flight_recorder_events`` dicts — off the loop thread."""
+        snap = await asyncio.get_running_loop().run_in_executor(
+            None, self.srv.flightrec_snapshot)
+        if snap is None:
+            raise _HTTPError(
+                404, "flight recorder disabled — set "
+                     "serving.flight_recorder (docs/observability.md)")
+        return await self._respond(writer, 200, snap)
+
+    async def _debug_profile(self, query, writer):
+        """On-demand ``jax.profiler`` capture: blocks an executor
+        thread for ``secs`` (clamped to 60), never the loop; one
+        capture at a time (409 while one runs)."""
+        if not getattr(self.srv.config, "profile_endpoint", False):
+            raise _HTTPError(
+                404, "profiling endpoint disabled — set "
+                     "serving.profile_endpoint (docs/observability.md)")
+        import math
+        import urllib.parse
+        params = urllib.parse.parse_qs(query)
+        try:
+            secs = float(params.get("secs", ["1"])[0])
+        except ValueError:
+            raise _HTTPError(400, f"secs must be a number, got "
+                                  f"{params.get('secs')!r}")
+        if not math.isfinite(secs):      # NaN slips through min/max
+            raise _HTTPError(400, f"secs must be finite, got {secs!r}")
+        secs = min(max(secs, 0.0), 60.0)
+
+        def capture():
+            if not self._profile_lock.acquire(blocking=False):
+                raise _HTTPError(409, "a profile capture is already "
+                                      "running — retry when it ends")
+            try:
+                import tempfile
+                import jax
+                d = tempfile.mkdtemp(prefix="dstpu_profile_")
+                jax.profiler.start_trace(d)
+                try:
+                    time.sleep(secs)
+                finally:
+                    jax.profiler.stop_trace()
+                return d
+            finally:
+                self._profile_lock.release()
+
+        d = await asyncio.get_running_loop().run_in_executor(
+            None, capture)
+        return await self._respond(
+            writer, 200, {"trace_dir": d, "secs": secs})
 
 
 def serve_http(srv, **kwargs):

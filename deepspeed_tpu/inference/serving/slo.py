@@ -50,7 +50,18 @@ class RequestResult:
     other terminal status; ``detail`` carries the human-readable reason
     (which deadline, which dispatch failure, ...).  ``ttft_s`` is
     submit-to-first-token wall time (``None`` when the request never
-    produced a token)."""
+    produced a token).
+
+    With ``serving.tracing`` on, the latency breakdown fields are
+    populated from the request's span tree (``docs/observability.md``):
+    ``queue_s`` (submit → admission start), ``prefill_s`` (admission
+    start → admit dispatched), ``host_s`` (admit dispatched → first
+    token PROCESSED — the lag-one event latency plus host bookkeeping),
+    ``decode_s`` (first token → terminal) and ``latency_s`` (submit →
+    terminal).  By construction ``queue_s + prefill_s + host_s +
+    decode_s == latency_s``.  ``None`` with tracing off (seed
+    behavior), and any phase the request never reached stays ``None``
+    (a shed-while-queued request has only ``queue_s``/``latency_s``)."""
     rid: int
     status: str
     output: Optional[np.ndarray] = None
@@ -59,6 +70,11 @@ class RequestResult:
     submitted_it: int = 0
     finished_it: Optional[int] = None
     ttft_s: Optional[float] = None
+    queue_s: Optional[float] = None
+    prefill_s: Optional[float] = None
+    decode_s: Optional[float] = None
+    host_s: Optional[float] = None
+    latency_s: Optional[float] = None
 
 
 class QueueFull(RuntimeError):

@@ -3,7 +3,19 @@
 (``monitor.py:29``) over TensorBoard / WandB / CSV backends.
 
 Events are ``(name, value, global_step)`` tuples via ``write_events``,
-exactly the reference protocol, so engine-side call sites port 1:1."""
+exactly the reference protocol, so engine-side call sites port 1:1.
+
+Lifecycle: every backend supports ``flush()`` (push buffered events to
+durable storage) and ``close()`` (flush + release handles), and the ABC
+is a context manager — short-lived serving processes wrap the monitor
+in ``with`` so tail events are never dropped on exit.  The CSV backend
+keeps its per-series file handles OPEN between ``write_events`` calls
+(no per-event open/close syscalls) and flushes each batch by default;
+``csvMonitor(cfg, batch_flush=False)`` opts into full buffering, where
+an explicit flush/close (or the context manager) is REQUIRED or a
+process exiting right after its last write loses the buffered tail.
+The serving engine calls ``monitor.flush()`` on
+``close()``/``preempt()``."""
 
 import os
 import csv as _csv
@@ -20,6 +32,21 @@ class Monitor(ABC):
     @abstractmethod
     def write_events(self, event_list):
         ...
+
+    def flush(self):
+        """Push buffered events to durable storage (default: no-op for
+        backends that write through)."""
+
+    def close(self):
+        """Flush and release any handles; idempotent."""
+        self.flush()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
 
 
 class TensorBoardMonitor(Monitor):
@@ -46,6 +73,16 @@ class TensorBoardMonitor(Monitor):
         if flush:
             self.summary_writer.flush()
 
+    def flush(self):
+        if self.summary_writer is not None:
+            self.summary_writer.flush()
+
+    def close(self):
+        if self.summary_writer is not None:
+            self.summary_writer.close()
+            self.summary_writer = None
+            self.enabled = False
+
 
 class WandbMonitor(Monitor):
 
@@ -68,35 +105,76 @@ class WandbMonitor(Monitor):
         for name, value, step in event_list:
             self.wandb.log({name: value}, step=int(step))
 
+    def close(self):
+        if self.enabled:
+            self.wandb.finish()
+            self.enabled = False
+
 
 class csvMonitor(Monitor):
+    """CSV backend: one ``<series>.csv`` per event name.  File handles
+    stay OPEN across ``write_events`` calls (the per-event open/append/
+    close of the old implementation cost a syscall triplet per sample on
+    the serving metrics path); each ``write_events`` batch ends with a
+    flush of the files it touched, so durability stays per-batch like
+    the old implementation — callers that never ``flush()``/``close()``
+    (the training engine, the fault supervisor) keep their rows on
+    disk.  ``batch_flush=False`` opts into full buffering for
+    high-frequency writers that DO flush/close (or use the monitor as
+    a context manager)."""
 
-    def __init__(self, csv_config):
+    def __init__(self, csv_config, batch_flush=True):
         super().__init__(csv_config)
         self.enabled = csv_config.enabled
+        self.batch_flush = batch_flush
         self.output_path = csv_config.output_path or "./csv_monitor"
         self.job_name = csv_config.job_name
-        self.filehandles = {}
+        self.filehandles = {}            # path -> (file, csv.writer)
         if self.enabled:
             os.makedirs(os.path.join(self.output_path, self.job_name), exist_ok=True)
+
+    def _entry(self, name):
+        safe = name.replace("/", "_")
+        path = os.path.join(self.output_path, self.job_name, f"{safe}.csv")
+        entry = self.filehandles.get(path)
+        if entry is None:
+            new = not os.path.exists(path)
+            f = open(path, "a", newline="")
+            w = _csv.writer(f)
+            if new:
+                w.writerow(["step", safe])
+            entry = self.filehandles[path] = (f, w)
+        return entry
 
     def write_events(self, event_list):
         if not self.enabled:
             return
+        touched = []
         for name, value, step in event_list:
-            safe = name.replace("/", "_")
-            path = os.path.join(self.output_path, self.job_name, f"{safe}.csv")
-            new = not os.path.exists(path)
-            with open(path, "a", newline="") as f:
-                w = _csv.writer(f)
-                if new:
-                    w.writerow(["step", safe])
-                w.writerow([int(step), float(value)])
+            f, w = self._entry(name)
+            w.writerow([int(step), float(value)])
+            touched.append(f)
+        if self.batch_flush:
+            for f in touched:
+                f.flush()
+
+    def flush(self):
+        for f, _ in self.filehandles.values():
+            f.flush()
+
+    def close(self):
+        for f, _ in self.filehandles.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self.filehandles.clear()
 
 
 class MonitorMaster(Monitor):
     """Fan events out to all enabled backends; only JAX process 0 writes
-    (reference gates on rank 0, ``monitor.py:29``)."""
+    (reference gates on rank 0, ``monitor.py:29``).  ``flush``/``close``
+    fan out too, and the master composes as a context manager."""
 
     def __init__(self, monitor_config):
         super().__init__(monitor_config)
@@ -114,3 +192,11 @@ class MonitorMaster(Monitor):
     def write_events(self, event_list):
         for backend in self.backends:
             backend.write_events(event_list)
+
+    def flush(self):
+        for backend in self.backends:
+            backend.flush()
+
+    def close(self):
+        for backend in self.backends:
+            backend.close()

@@ -219,6 +219,35 @@ def active():
 
 _fire_lock = threading.Lock()
 
+# fire observers: ``cb(point, action, hit_no)`` invoked for every spec
+# that FIRES (before its action runs — exit/raise/hang must not lose the
+# record).  The serving flight recorder registers one so chaos-drill
+# post-mortems show exactly which seam fired before the fallout.
+# Observers must be cheap and non-raising; exceptions are swallowed.
+_observers = []
+
+
+def add_fire_observer(cb):
+    """Register ``cb(point, action, hit_no)``; returns ``cb`` (handy for
+    symmetric :func:`remove_fire_observer` calls)."""
+    _observers.append(cb)
+    return cb
+
+
+def remove_fire_observer(cb):
+    try:
+        _observers.remove(cb)
+    except ValueError:
+        pass
+
+
+def _notify(point, spec):
+    for cb in list(_observers):
+        try:
+            cb(point, spec.action, spec.hits)
+        except Exception:                # noqa: BLE001 — observer only
+            pass
+
 
 def fire(point, path=None):
     """Hit an injection point.  No-op unless a spec is armed for it.
@@ -242,6 +271,7 @@ def fire(point, path=None):
             spec.fired += 1
             to_run.append(spec)
     for spec in to_run:
+        _notify(point, spec)
         _execute(spec, path)
 
 

@@ -38,7 +38,15 @@ def main(argv=None):
     parser.add_argument("--update", action="store_true",
                         help="with --contracts: rewrite PROGRAMS.lock "
                              "from the freshly extracted contracts")
+    parser.add_argument("--stats-docs", action="store_true",
+                        help="assert every serving stats key and "
+                             "/metrics series is documented in "
+                             "docs/observability.md (exit 1 on drift)")
     args = parser.parse_args(argv)
+
+    if args.stats_docs:
+        from deepspeed_tpu.tools.lint import stats_docs
+        return stats_docs.main()
 
     if args.update and not args.contracts:
         print("tpu-lint: error: --update only applies to --contracts",

@@ -1,0 +1,153 @@
+"""``ds_lint --stats-docs`` — the serving metric surface must not drift
+undocumented (``docs/observability.md``).
+
+Statically (never importing the code under analysis — the linter
+discipline every other gate here follows) collects:
+
+* every ``stats`` counter key the serving engine touches
+  (``inference/serving/engine.py``: the ``self.stats = {...}`` literal,
+  ``stats.update({...})`` calls and ``stats["key"]`` /
+  ``stats.get("key")`` accesses), and
+* every ``/metrics`` series name the HTTP front end exports
+  (``frontend/transport.py``: ``gauge("name", ...)`` first arguments
+  prefixed ``dstpu_serving_``, plus full ``dstpu_*`` string literals)
+  and the histogram families ``monitor/trace.py`` declares in its
+  ``HISTOGRAM_SERIES`` literal,
+
+then asserts each appears as a backticked token in the observability
+doc's tables.  Exit 1 lists what is missing; wired into tier-1 via
+``tests/unit/test_tpu_lint.py`` so a new counter or series cannot land
+without its documentation row.
+"""
+
+import ast
+import os
+import re
+import sys
+
+_PKG = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+ENGINE_PY = os.path.join(_PKG, "inference", "serving", "engine.py")
+TRANSPORT_PY = os.path.join(_PKG, "inference", "serving", "frontend",
+                            "transport.py")
+TRACE_PY = os.path.join(_PKG, "monitor", "trace.py")
+DOC_MD = os.path.join(os.path.dirname(_PKG), "docs", "observability.md")
+
+
+def _parse(path):
+    with open(path, encoding="utf-8") as f:
+        return ast.parse(f.read(), filename=path)
+
+
+def _is_stats_attr(node):
+    """True for ``<anything>.stats`` attribute nodes (``self.stats``,
+    ``srv.stats``)."""
+    return isinstance(node, ast.Attribute) and node.attr == "stats"
+
+
+def _dict_str_keys(node):
+    if not isinstance(node, ast.Dict):
+        return
+    for k in node.keys:
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            yield k.value
+
+
+def collect_stats_keys(engine_path=ENGINE_PY):
+    """Every string key the engine reads/writes on a ``stats`` dict."""
+    keys = set()
+    for node in ast.walk(_parse(engine_path)):
+        # self.stats = {...} / self.stats.update({...})
+        if isinstance(node, ast.Assign) \
+                and any(_is_stats_attr(t) for t in node.targets):
+            keys.update(_dict_str_keys(node.value))
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("update", "get", "setdefault") \
+                and _is_stats_attr(node.func.value):
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str):
+                    keys.add(arg.value)
+                keys.update(_dict_str_keys(arg))
+        # stats["key"] subscripts (reads and writes)
+        if isinstance(node, ast.Subscript) and _is_stats_attr(node.value):
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                keys.add(sl.value)
+    return keys
+
+
+def collect_metric_series(transport_path=TRANSPORT_PY,
+                          trace_path=TRACE_PY):
+    """Every ``/metrics`` series name: ``gauge("x", ...)`` calls (the
+    ``dstpu_serving_`` prefix is applied by the helper), whole
+    ``dstpu_*`` string literals, and the ``HISTOGRAM_SERIES`` tuple the
+    trace module declares as a pure literal."""
+    series = set()
+    for node in ast.walk(_parse(transport_path)):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else \
+                fn.attr if isinstance(fn, ast.Attribute) else None
+            if name == "gauge" and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                series.add(f"dstpu_serving_{node.args[0].value}")
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            v = node.value
+            # whole series names only — skip prefix fragments from
+            # f-strings (they end with the joining underscore)
+            if v.startswith("dstpu_") and not v.endswith("_") \
+                    and re.fullmatch(r"[a-z0-9_]+", v):
+                series.add(v)
+    for node in _parse(trace_path).body:
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name)
+                        and t.id == "HISTOGRAM_SERIES"
+                        for t in node.targets):
+            series.update(ast.literal_eval(node.value))
+    return series
+
+
+def doc_tokens(doc_path=DOC_MD):
+    """Backticked tokens in the observability doc (the metric tables)."""
+    try:
+        with open(doc_path, encoding="utf-8") as f:
+            text = f.read()
+    except FileNotFoundError:
+        return set()
+    return set(re.findall(r"`([^`\n]+)`", text))
+
+
+def main(doc_path=DOC_MD, engine_path=ENGINE_PY,
+         transport_path=TRANSPORT_PY, trace_path=TRACE_PY):
+    stats = collect_stats_keys(engine_path)
+    series = collect_metric_series(transport_path, trace_path)
+    tokens = doc_tokens(doc_path)
+    missing_stats = sorted(k for k in stats if k not in tokens)
+    missing_series = sorted(s for s in series if s not in tokens)
+    if not stats or not series:
+        print("tpu-lint[stats-docs]: error: collected "
+              f"{len(stats)} stats keys / {len(series)} series — the "
+              "collector lost its sources (engine/transport/trace "
+              "moved?)", file=sys.stderr)
+        return 2
+    if missing_stats or missing_series:
+        for k in missing_stats:
+            print(f"stats-docs: stats[{k!r}] is exported by the serving "
+                  f"engine but undocumented in {os.path.relpath(doc_path)}")
+        for s in missing_series:
+            print(f"stats-docs: /metrics series {s!r} is exported but "
+                  f"undocumented in {os.path.relpath(doc_path)}")
+        print(f"tpu-lint[stats-docs]: {len(missing_stats)} stats key(s) "
+              f"+ {len(missing_series)} series missing from the docs "
+              f"table — add rows to docs/observability.md")
+        return 1
+    print(f"tpu-lint[stats-docs]: OK — {len(stats)} stats keys and "
+          f"{len(series)} /metrics series all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

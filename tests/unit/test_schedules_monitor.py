@@ -95,12 +95,53 @@ def test_csv_monitor_and_master(tmp_path):
     assert master.enabled
     master.write_events([("Train/loss", 1.5, 10), ("Train/lr", 0.1, 10)])
     master.write_events([("Train/loss", 1.2, 20)])
+    # the CSV backend keeps its handles OPEN and buffered between
+    # write_events calls — flush() makes the rows durable
+    master.flush()
     files = [f for root, _, fs in os.walk(tmp_path) for f in fs]
     assert any(f.endswith(".csv") for f in files), files
     csvs = [os.path.join(root, f) for root, _, fs in os.walk(tmp_path)
             for f in fs if "loss" in f]
     content = open(csvs[0]).read()
     assert "1.5" in content and "1.2" in content
+    master.close()
+
+
+def test_csv_monitor_flush_modes_and_context_manager(tmp_path):
+    """The flush/close contract (docs/observability.md): the default
+    backend is durable per write_events batch (training engines never
+    flush); batch_flush=False buffers in the persistent handle until
+    flush()/close(), and the context manager closes — so a short-lived
+    serving process using `with` never drops its tail events."""
+    from deepspeed_tpu.monitor.monitor import csvMonitor
+    from deepspeed_tpu.runtime.config import CSVConfig
+
+    cfg = CSVConfig(enabled=True, output_path=str(tmp_path),
+                    job_name="job")
+    path = os.path.join(str(tmp_path), "job", "Serving_tok_s.csv")
+    # default: every batch is durable without an explicit flush (the
+    # seed contract non-serving callers rely on)
+    mon0 = csvMonitor(cfg)
+    mon0.write_events([("Serving/tok_s", 1.75, 0)])
+    assert "1.75" in open(path).read()
+    mon0.close()
+
+    mon = csvMonitor(cfg, batch_flush=False)
+    mon.write_events([("Serving/tok_s", 3.25, 1)])
+    # a tiny row sits in the userspace buffer: the file on disk does
+    # not yet hold it until flush()
+    assert "3.25" not in open(path).read()
+    mon.flush()
+    assert "3.25" in open(path).read()
+    mon.write_events([("Serving/tok_s", 7.5, 2)])
+    mon.close()                          # close flushes
+    assert "7.5" in open(path).read()
+    assert not mon.filehandles           # handles released
+
+    # context-manager form: exit closes (and therefore flushes)
+    with csvMonitor(cfg, batch_flush=False) as mon2:
+        mon2.write_events([("Serving/tok_s", 9.125, 3)])
+    assert "9.125" in open(path).read()
 
 
 # ------------------------------------------------------------------ #

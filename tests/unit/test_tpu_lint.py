@@ -87,6 +87,31 @@ def test_cli_concurrency_exits_nonzero_on_unlocked_access(capsys):
     assert "TL008" in out and "tpu-lint[concurrency]" in out
 
 
+def test_cli_stats_docs_gate_green_and_detects_drift(tmp_path, capsys):
+    """`ds_lint --stats-docs` (tier-1): every serving stats key and
+    /metrics series must appear backticked in docs/observability.md —
+    green on the repo as committed, exit 1 when the doc loses a key,
+    exit 2 when the collector loses its sources."""
+    from deepspeed_tpu.tools.lint import stats_docs
+    assert lint_main(["--stats-docs"]) == 0
+    out = capsys.readouterr().out
+    assert "stats keys" in out and "documented" in out
+    # the collectors see the real metric surface
+    keys = stats_docs.collect_stats_keys()
+    series = stats_docs.collect_metric_series()
+    assert {"iterations", "decode_tokens", "completed",
+            "lock_wait_scheduler_s"} <= keys
+    assert {"dstpu_serving_queue_depth", "dstpu_serving_ttft_seconds",
+            "dstpu_serving_lock_wait_seconds"} <= series
+    # drift detection: a doc missing everything but one key fails loudly
+    thin = tmp_path / "obs.md"
+    thin.write_text("| `iterations` | count |\n")
+    assert stats_docs.main(doc_path=str(thin)) == 1
+    out = capsys.readouterr().out
+    assert "decode_tokens" in out and "dstpu_serving_ttft_seconds" in out
+    capsys.readouterr()
+
+
 def test_cli_concurrency_clean_paths_reach_the_prover(capsys, monkeypatch):
     """With a clean sweep, --concurrency hands off to the interleaving
     harness (stubbed here — the real harness runs as its own tier-1

@@ -119,7 +119,7 @@ def spmd_pipeline(stage_fn, stacked_params, x0, num_micro, mesh,
             outs = lax.psum(outs, pp_axis)
         return outs
 
-    in_specs = (jax.tree.map(lambda _: P(pp_axis), stacked_params), P())
+    in_specs = (jax.tree.map(lambda _: P(pp_axis), stacked_params), P())  # tpu-lint: disable=TL010 -- every stage needs the full microbatch stream: the region slices its own microbatch per tick in-program; batch sharding over edp runs manually inside (jax_compat axis_names fallback)
     out = _shard_map(
         region, mesh=mesh, in_specs=in_specs, out_specs=P(),
         axis_names=frozenset({pp_axis}), check_vma=False,
@@ -377,7 +377,7 @@ def spmd_pipeline_1f1b(stage_fn, stacked_params, first_fn, first_params,
         return loss_acc, gbody, gfirst, glast
 
     in_specs = (jax.tree.map(lambda _: P(pp_axis), stacked_params),
-                P(), P(), P(), P(), P())
+                P(), P(), P(), P(), P())  # tpu-lint: disable=TL010 -- the 1F1B region consumes the full [M, ...] microbatch stream and slices per tick in-program (stages see different microbatches at different ticks); edp batch sharding runs manually inside the region
     out_specs = (P(), jax.tree.map(lambda _: P(pp_axis), stacked_params),
                  P(), P())
     return _shard_map(

@@ -21,7 +21,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 import jax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # Canonical axis names.
 PP_AXIS = "pp"      # pipeline stages
@@ -146,6 +146,29 @@ class ParallelTopology:
 
     def replicated_spec(self):
         return P()
+
+    # ------------------------------------------------------------------ #
+    # Introspection hooks — used by the comm-cost analyzer, the sharding
+    # lint's registry tests, and the PartitionSpec-helper placement tests.
+    # ------------------------------------------------------------------ #
+    def axis_sizes(self):
+        """``{axis: size}`` of the live mesh (all six canonical axes)."""
+        return {k: int(v) for k, v in self.mesh.shape.items()}
+
+    def shard_shape(self, spec, global_shape):
+        """Per-device shard shape a ``PartitionSpec`` produces for a
+        global array shape on THIS mesh — the statically checkable
+        ground truth the spec helpers are validated against (a replicated
+        batch dim shows up here as a full-size shard on every device)."""
+        return NamedSharding(self.mesh, spec).shard_shape(
+            tuple(global_shape))
+
+    def shards_per_device(self, spec, global_shape):
+        """Fraction of a global array each device holds under ``spec``
+        (1.0 = fully replicated — the TL010 smell, numerically)."""
+        shard = self.shard_shape(spec, global_shape)
+        total = float(np.prod(global_shape)) or 1.0
+        return float(np.prod(shard)) / total
 
 
 # --------------------------------------------------------------------- #

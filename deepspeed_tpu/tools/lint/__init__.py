@@ -25,6 +25,12 @@ Rules (each suppressible per line or per function via
   routed through ``run_in_executor``, or an owner-bound driving method
   (``step``/``drain``/``preempt``) in a context that can never be the
   scheduler owner
+* **TL010** implicit replication at mesh boundaries (unspecced
+  ``shard_map``/mesh-context jit, bare ``P()`` on batch/sequence-scaling
+  arrays) — paired with the byte-level comm budgets in ``PROGRAMS.lock``
+* **TL011** implicit resharding seams (``device_put`` /
+  ``with_sharding_constraint`` inside hot paths, literal mesh-axis names
+  outside the canonical topology)
 
 CLI: ``python -m deepspeed_tpu.tools.lint [paths]`` (or ``bin/ds_lint``);
 exits non-zero when any unsuppressed finding remains.  ``--jaxpr`` runs
@@ -33,8 +39,13 @@ which traces the registered hot-path entry points and verifies — at the
 compiler level — that they contain no host callbacks and that declared
 donations actually alias.  ``--contracts [--update]`` regenerates the
 program-contract lockfile (:mod:`deepspeed_tpu.tools.lint.contract`,
-``PROGRAMS.lock``) and diffs it per program.  ``--concurrency`` runs the
-TL008/TL009 sweep and, when clean, the interleaving stress harness.
+``PROGRAMS.lock``) and diffs it per program — including the byte-level
+comm budgets and {1,2,4,8} mesh-scaling tables
+(:mod:`deepspeed_tpu.tools.lint.comm_contract`).  ``--concurrency`` runs
+the TL008/TL009 sweep and, when clean, the interleaving stress harness.
+``--comm`` runs the TL010/TL011 sharding sweep and, when clean, the
+mesh-scaling prover (per-chip byte volumes must not grow with mesh size
+unless declared).
 """
 
 from deepspeed_tpu.tools.lint.core import Finding, RULES, run_lint  # noqa: F401

@@ -35,6 +35,15 @@ def main(argv=None):
                              "package), then — when the sweep is clean — "
                              "the interleaving stress harness under "
                              "DSTPU_CONCURRENCY_CHECKS=1")
+    parser.add_argument("--comm", action="store_true",
+                        help="run the comm-contract gate: the TL010/TL011 "
+                             "sharding-lint sweep over the given paths "
+                             "(default: the installed package), then — "
+                             "when the sweep is clean — the mesh-scaling "
+                             "prover (compile every sharding plan at mesh "
+                             "sizes 1/2/4/8, diff bytes-per-chip against "
+                             "PROGRAMS.lock, fail on undeclared per-chip "
+                             "growth)")
     parser.add_argument("--update", action="store_true",
                         help="with --contracts: rewrite PROGRAMS.lock "
                              "from the freshly extracted contracts")
@@ -60,6 +69,12 @@ def main(argv=None):
         from deepspeed_tpu.tools.lint import contract, jaxpr_check
         contract.ensure_harness_env()
         return jaxpr_check.main()
+    if args.comm:
+        # tier-1 env forced like --contracts so the CLI and CI agree on
+        # the mesh the plans compile against
+        from deepspeed_tpu.tools.lint import comm_contract, contract
+        contract.ensure_harness_env()
+        return comm_contract.main(args.paths or None)
     if args.concurrency:
         # the tier-1 env is forced like --contracts/--jaxpr so the CLI
         # and the CI gate agree on what they check

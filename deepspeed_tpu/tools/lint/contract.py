@@ -16,7 +16,14 @@ a committed artifact:
   MiCS via :mod:`deepspeed_tpu.parallel.plans`), so the MULTICHIP dry-run's
   re-measured totals become a statically locked schedule;
 * **input/output abstract signatures** — a shape or dtype drift in a
-  donated workspace is a contract break, not a runtime surprise.
+  donated workspace is a contract break, not a runtime surprise;
+* **communication-cost budgets** (:mod:`.comm_contract`) — per-collective
+  byte volumes per step parsed from the optimized HLO, and a
+  ``mesh_scaling`` section locking bytes-per-chip for every sharding plan
+  at mesh sizes {1, 2, 4, 8}: "all-gather bytes: 2.1MB -> 67MB" is a
+  reviewable regression where a bare count change is not, and a per-chip
+  volume that GROWS with mesh size is the replicated-tensor smell the
+  ``ds_lint --comm`` prover fails on.
 
 ``PROGRAMS.lock`` (repo root, committed) is regenerated-and-diffed by a
 tier-1 gate and by ``ds_lint --contracts`` (``--update`` rewrites it); a
@@ -111,12 +118,22 @@ def _multiset_hash(counts):
 def contract_of_entry_point(ep):
     """Machine-checkable contract of one :class:`entry_points.EntryPoint`:
     traced primitive multiset + hash, host-callback count, jaxpr-level
-    collective counts, lowered donation-alias count, and the abstract
-    input/output signatures."""
+    collective counts, lowered donation-alias count, the abstract
+    input/output signatures, and the byte-level comm budget (``{}`` for a
+    program whose lowering mentions no collective — the single-chip hot
+    paths answer without paying for a compile; a mesh-aware program is
+    compiled and its optimized HLO costed)."""
+    import jax
+    from deepspeed_tpu.tools.lint import comm_contract
     from deepspeed_tpu.tools.lint.jaxpr_check import FORBIDDEN_PRIMITIVES
     counts, closed = primitive_counts_of(ep.fn, *ep.args)
-    text = ep.fn.lower(*ep.args).as_text()
+    lowered = ep.fn.lower(*ep.args)
+    text = lowered.as_text()
     aliased = sum(text.count(a) for a in _ALIAS_ATTRS)
+    comm = {}
+    if comm_contract.lowered_has_collectives(text):
+        hlo = lowered.compile().as_text() or ""
+        comm = comm_contract.parse_hlo_comm(hlo, jax.device_count())
     return {
         "kind": "program",
         "primitives": dict(sorted(counts.items())),
@@ -125,6 +142,7 @@ def contract_of_entry_point(ep):
                               if p in FORBIDDEN_PRIMITIVES),
         "collectives": {p: counts[p] for p in JAXPR_COLLECTIVES
                         if p in counts},
+        "comm": comm,
         "donation": {"declared": bool(ep.expect_donation),
                      "aliased": aliased,
                      "min_aliased": int(getattr(ep, "min_aliased", 0))},
@@ -135,9 +153,12 @@ def contract_of_entry_point(ep):
 
 def contract_of_plan(plan):
     """Collective-schedule contract of one
-    :class:`parallel.plans.PlanProgram`: the counts of every collective op
-    in the OPTIMIZED HLO the plan's fused train step compiles to on the
-    8-device mesh (what the MULTICHIP dry-run measures at runtime)."""
+    :class:`parallel.plans.PlanProgram`: the counts AND byte volumes of
+    every collective op in the OPTIMIZED HLO the plan's fused train step
+    compiles to on the 8-device mesh (what the MULTICHIP dry-run measures
+    at runtime).  The one compile feeds both the count schedule and the
+    comm budget."""
+    from deepspeed_tpu.tools.lint import comm_contract
     text = plan.fn.lower(*plan.args).compile().as_text() or ""
     counts = {}
     for op in HLO_COLLECTIVES:
@@ -147,7 +168,9 @@ def contract_of_plan(plan):
     return {
         "kind": "collective_schedule",
         "mesh": {k: int(v) for k, v in sorted(plan.mesh.items())},
+        "world": int(plan.world),
         "collectives": counts,
+        "comm": comm_contract.parse_hlo_comm(text, plan.world),
         "expect": sorted(plan.expect),
         "reduction": bool(plan.reduction),
     }
@@ -156,7 +179,8 @@ def contract_of_plan(plan):
 def validate_plan_contract(contract):
     """Semantic invariants of a plan schedule (on top of the exact locked
     counts): every expected collective present; reduction plans carry at
-    least one all-reduce/reduce-scatter."""
+    least one all-reduce/reduce-scatter; the comm budget's instance counts
+    agree with the count schedule (the two parsers walk the same HLO)."""
     problems = []
     c = contract.get("collectives", {})
     for op in contract.get("expect", []):
@@ -165,6 +189,13 @@ def validate_plan_contract(contract):
     if contract.get("reduction") and not (
             c.get("all-reduce", 0) + c.get("reduce-scatter", 0)):
         problems.append(f"no gradient-reduction collective scheduled: {c}")
+    comm = contract.get("comm")
+    if comm is not None:
+        counted = {op: v.get("count", 0) for op, v in comm.items()}
+        if counted != c:
+            problems.append(
+                f"comm-budget instance counts disagree with the count "
+                f"schedule: {counted} vs {c}")
     return problems
 
 
@@ -200,12 +231,30 @@ def build_plan_contract(plan_builder_name):
         reset_topology()
 
 
+def build_plan_scaling_contract(plan_builder_name, full_contract=None):
+    """The mesh-scaling contract of one plan family.  ``full_contract``
+    optionally supplies the already-compiled full-mesh (world=8) schedule
+    contract so its point is derived instead of re-compiled — the gate and
+    ``build_all`` both reuse the canonical compile, which also makes the
+    table's top row definitionally consistent with the locked schedule."""
+    from deepspeed_tpu.parallel import plans
+    from deepspeed_tpu.tools.lint import comm_contract
+    builder = getattr(plans, plan_builder_name)
+    reuse_rows = {}
+    if full_contract is not None:
+        reuse_rows[full_contract["world"]] = comm_contract.scaling_entry(
+            full_contract["world"], full_contract["mesh"],
+            full_contract.get("comm", {}))
+    return comm_contract.build_scaling_contract(builder,
+                                                reuse_rows=reuse_rows)
+
+
 def build_all(progress=None):
     """Regenerate every contract.  Returns the lockfile dict."""
     import jax
     import jaxlib
     from deepspeed_tpu.parallel import plans
-    programs, schedules = {}, {}
+    programs, schedules, scaling = {}, {}, {}
     for bname in program_names():
         if progress:
             progress(f"tracing {bname}")
@@ -216,9 +265,15 @@ def build_all(progress=None):
             progress(f"compiling plan {build.__name__}")
         name, c = build_plan_contract(build.__name__)
         schedules[name] = c
+        if progress:
+            progress(f"scaling {build.__name__} over mesh "
+                     f"{plans.MESH_POINTS}")
+        sname, sc = build_plan_scaling_contract(build.__name__,
+                                                full_contract=c)
+        scaling[sname or name] = sc
     return {
         "_meta": {
-            "format": 1,
+            "format": 2,
             "harness": "JAX_PLATFORMS=cpu, 8 virtual devices (tier-1)",
             "jax": jax.__version__,
             "jaxlib": jaxlib.__version__,
@@ -226,6 +281,7 @@ def build_all(progress=None):
         },
         "programs": programs,
         "collective_schedules": schedules,
+        "mesh_scaling": scaling,
     }
 
 
@@ -257,6 +313,36 @@ def _diff_counts(label, old, new, out):
     return bool(changed)
 
 
+def _diff_comm(locked, fresh, out):
+    """Byte-level comm-budget diff lines — the readable half of a comm
+    regression: 'all-gather bytes: 2.1MB -> 67MB per step'."""
+    from deepspeed_tpu.tools.lint.comm_contract import fmt_bytes
+    for op in sorted(set(locked) | set(fresh)):
+        lo = locked.get(op, {})
+        fr = fresh.get(op, {})
+        lb, fb = lo.get("bytes_per_step", 0), fr.get("bytes_per_step", 0)
+        if lb != fb:
+            out.append(f"  {op} bytes: {fmt_bytes(lb)} -> {fmt_bytes(fb)} "
+                       f"per step")
+        if lo.get("count", 0) != fr.get("count", 0):
+            out.append(f"  comm.{op} instances: {lo.get('count', 0)} -> "
+                       f"{fr.get('count', 0)}")
+
+
+def _schedule_summary(contract):
+    """One-line schedule rendering (counts + bytes when budgeted) for the
+    side-by-side view of a changed schedule."""
+    from deepspeed_tpu.tools.lint.comm_contract import fmt_bytes
+    counts = contract.get("collectives", {})
+    comm = contract.get("comm", {})
+    parts = []
+    for op in sorted(counts):
+        b = comm.get(op, {}).get("bytes_per_step")
+        parts.append(f"{op} x{counts[op]}"
+                     + (f" ({fmt_bytes(b)})" if b is not None else ""))
+    return "{" + ", ".join(parts) + "}" if parts else "{none}"
+
+
 def diff_program(name, locked, fresh):
     """Readable field-by-field diff of one program's contract.  Empty list
     = contracts match."""
@@ -265,12 +351,19 @@ def diff_program(name, locked, fresh):
         out.append(f"  kind: {locked.get('kind')} -> {fresh.get('kind')}")
     if locked.get("kind") == "collective_schedule" or \
             fresh.get("kind") == "collective_schedule":
-        _diff_counts("collectives", locked.get("collectives", {}),
-                     fresh.get("collectives", {}), out)
-        for field in ("mesh", "expect", "reduction"):
+        changed = _diff_counts("collectives", locked.get("collectives", {}),
+                               fresh.get("collectives", {}), out)
+        _diff_comm(locked.get("comm", {}) or {},
+                   fresh.get("comm", {}) or {}, out)
+        for field in ("mesh", "expect", "reduction", "world"):
             if locked.get(field) != fresh.get(field):
                 out.append(f"  {field}: {locked.get(field)} -> "
                            f"{fresh.get(field)}")
+        if changed:
+            # a schedule change is easier to review whole than as field
+            # paths: print the old and new schedules side by side
+            out.append(f"  schedule: {_schedule_summary(locked)}")
+            out.append(f"         -> {_schedule_summary(fresh)}")
         return [f"{name}:"] + out if out else []
     if locked.get("primitives_sha256") != fresh.get("primitives_sha256"):
         _diff_counts("primitives", locked.get("primitives", {}),
@@ -284,6 +377,8 @@ def diff_program(name, locked, fresh):
                    f"stalls every dispatch on the host link)")
     _diff_counts("collectives", locked.get("collectives", {}),
                  fresh.get("collectives", {}), out)
+    _diff_comm(locked.get("comm", {}) or {}, fresh.get("comm", {}) or {},
+               out)
     ld, fd = locked.get("donation", {}), fresh.get("donation", {})
     if ld != fd:
         out.append(f"  donation: declared={ld.get('declared')} "
@@ -307,8 +402,9 @@ def diff_program(name, locked, fresh):
 def diff_lockfiles(locked, fresh):
     """Full diff: per-program field diffs plus added/removed programs.
     Empty list = lockfile up to date."""
+    from deepspeed_tpu.tools.lint.comm_contract import diff_scaling
     out: List[str] = []
-    for section in ("programs", "collective_schedules"):
+    for section in ("programs", "collective_schedules", "mesh_scaling"):
         lsec = locked.get(section, {})
         fsec = fresh.get(section, {})
         for name in sorted(set(lsec) | set(fsec)):
@@ -318,6 +414,8 @@ def diff_lockfiles(locked, fresh):
             elif name not in lsec:
                 out.append(f"{name}: not in {LOCKFILE_NAME} — new program; "
                            f"add via --contracts --update")
+            elif section == "mesh_scaling":
+                out.extend(diff_scaling(name, lsec[name], fsec[name]))
             else:
                 out.extend(diff_program(name, lsec[name], fsec[name]))
     return out
@@ -336,6 +434,10 @@ def check_against_lockfile(path=None, progress=None):
     for name, c in sorted(fresh.get("collective_schedules", {}).items()):
         for problem in validate_plan_contract(c):
             diff.append(f"{name}: plan invariant broken — {problem}")
+    from deepspeed_tpu.tools.lint.comm_contract import \
+        validate_scaling_contract
+    for name, c in sorted(fresh.get("mesh_scaling", {}).items()):
+        diff.extend(validate_scaling_contract(name, c))
     return not diff, diff
 
 

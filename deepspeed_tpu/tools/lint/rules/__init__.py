@@ -10,4 +10,6 @@ from deepspeed_tpu.tools.lint.rules import (  # noqa: F401
     tl007_use_after_donation,
     tl008_lock_discipline,
     tl009_loop_blocking,
+    tl010_replicated_sharding,
+    tl011_resharding_seams,
 )

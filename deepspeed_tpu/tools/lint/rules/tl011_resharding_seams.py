@@ -1,0 +1,117 @@
+"""TL011 — implicit resharding seams.
+
+A mesh program's sharding story should be decided at BUILD time (the
+``parallel/topology.py`` helpers) and locked by the comm-cost contracts.
+Two source patterns smuggle resharding decisions past that story:
+
+* ``jax.device_put`` / ``with_sharding_constraint`` inside a registered
+  ``@hot_path`` body — a mid-step placement change is an unscheduled,
+  host-synchronized reshard: it serializes the dispatch pipeline and its
+  collective traffic appears in no locked budget.  Placement belongs in
+  setup code; a constraint XLA genuinely needs in the step gets a
+  suppression with the reason.
+* a ``shard_map`` whose literal ``in_specs``/``out_specs`` (or a traced
+  collective's literal ``axis_name``) names a mesh axis that does not
+  exist in the canonical topology (``parallel/topology.py`` AXIS_ORDER:
+  pp/mdp/edp/ep/sp/tp) — an unknown axis either crashes at runtime or,
+  worse, silently no-ops the sharding and replicates (GSPMD treats an
+  unmatched axis as size 1).  Variable axis names (the common idiom) are
+  out of static reach; the canonical-literal check catches the typo class.
+
+``_CANONICAL_AXES`` mirrors ``topology.AXIS_ORDER`` as a pure literal (the
+linter never imports the code under analysis);
+``tests/unit/test_tpu_lint.py`` asserts the two stay identical.
+"""
+
+import ast
+
+from deepspeed_tpu.tools.lint.core import Finding, dotted_name, rule
+from deepspeed_tpu.tools.lint.rules.tl010_replicated_sharding import (
+    _callee_leaf, shard_map_applications, spec_entries)
+
+# mirror of parallel.topology.AXIS_ORDER — registry-matched by a test
+_CANONICAL_AXES = ("pp", "mdp", "edp", "ep", "sp", "tp")
+
+_RESHARD_CALLS = ("device_put", "with_sharding_constraint")
+# traced collectives whose first string argument is a mesh axis name
+_AXIS_COLLECTIVES = ("psum", "pmean", "pmax", "pmin", "all_gather",
+                     "all_to_all", "ppermute", "psum_scatter",
+                     "axis_index", "pbroadcast")
+
+
+def _literal_axis_names(node):
+    """String axis names in a P(...) entry: constants and tuples of
+    constants; anything non-literal is skipped."""
+    out = []
+    if not isinstance(node, ast.Call) or \
+            _callee_leaf(node.func) not in ("P", "PartitionSpec"):
+        return out
+    for arg in node.args:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append((arg.value, arg))
+        elif isinstance(arg, (ast.Tuple, ast.List)):
+            for e in arg.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.append((e.value, e))
+    return out
+
+
+@rule("TL011", "implicit resharding seams")
+def check(module):
+    # (a) mid-step placement changes inside hot paths
+    for fn in module.hot_functions():
+        nested = set()
+        for child in ast.walk(fn.node):
+            if child is not fn.node and isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested.update(ast.walk(child))
+        for node in ast.walk(fn.node):
+            if node in nested or not isinstance(node, ast.Call):
+                continue
+            leaf = _callee_leaf(node.func)
+            if leaf in _RESHARD_CALLS:
+                yield Finding(
+                    "TL011", module.path, node.lineno, node.col_offset,
+                    f"{leaf} inside hot path '{fn.hot_name or fn.name}' — "
+                    f"a mid-step reshard is host-synchronized and its "
+                    f"collective traffic is in no locked comm budget; "
+                    f"place buffers at setup time (suppress with the "
+                    f"reason when the constraint is the design)")
+
+    # (b) literal axis names the canonical topology does not define
+    for line, col, kwargs, _params in shard_map_applications(module):
+        for key in ("in_specs", "out_specs"):
+            entries = spec_entries(module, kwargs.get(key), line) or []
+            for entry in entries:
+                for sub in ast.walk(entry):
+                    for axis, node in _literal_axis_names(sub):
+                        if axis not in _CANONICAL_AXES:
+                            yield Finding(
+                                "TL011", module.path, node.lineno,
+                                node.col_offset,
+                                f"shard_map {key} names mesh axis "
+                                f"{axis!r} — not a canonical topology "
+                                f"axis {_CANONICAL_AXES}; an unmatched "
+                                f"axis silently replicates (GSPMD treats "
+                                f"it as size 1)")
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Call)
+                and _callee_leaf(node.func) in _AXIS_COLLECTIVES):
+            continue
+        axis_args = [a for a in node.args[:2]
+                     if isinstance(a, ast.Constant)
+                     and isinstance(a.value, str)]
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis") and \
+                    isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, str):
+                axis_args.append(kw.value)
+        for arg in axis_args:
+            if arg.value not in _CANONICAL_AXES:
+                yield Finding(
+                    "TL011", module.path, arg.lineno, arg.col_offset,
+                    f"collective {_callee_leaf(node.func)} over literal "
+                    f"axis {arg.value!r} — not a canonical topology axis "
+                    f"{_CANONICAL_AXES}; the topology helpers "
+                    f"(parallel/topology.py) are the one source of axis "
+                    f"names")

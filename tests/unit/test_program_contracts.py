@@ -2,11 +2,18 @@
 ``PROGRAMS.lock``).
 
 Tier-1 regenerates every contract — primitive multiset, donation-alias
-count, collective counts, abstract signatures — from the REAL hot-path
-programs and the ``parallel/`` sharding plans, and diffs them against the
-committed lockfile: a lost donation, a new host callback, a surprise
-collective, or a drifted signature fails here with a readable per-program
-diff instead of surfacing as an HBM cliff rounds later."""
+count, collective counts, byte-level comm budgets, abstract signatures —
+from the REAL hot-path programs and the ``parallel/`` sharding plans, and
+diffs them against the committed lockfile: a lost donation, a new host
+callback, a surprise collective, a byte-volume regression, or a drifted
+signature fails here with a readable per-program diff instead of
+surfacing as an HBM cliff rounds later.
+
+The mesh-scaling tables ({1,2,4,8} bytes/chip per plan) are consistency-
+checked here for free; their full regen-and-diff compiles 12 extra plan
+points (~2 min) and runs as the ``slow``-marked test at the bottom and as
+``ds_lint --comm`` — this container's tier-1 wall-clock budget cannot
+absorb the compiles."""
 
 import json
 import re
@@ -14,7 +21,7 @@ import pathlib
 
 import pytest
 
-from deepspeed_tpu.tools.lint import contract
+from deepspeed_tpu.tools.lint import comm_contract, contract
 
 HERE = pathlib.Path(__file__).resolve().parent
 REPO = HERE.parents[1]
@@ -205,3 +212,197 @@ def test_diff_lockfiles_reports_added_and_removed():
     text = "\n".join(contract.diff_lockfiles(a, b))
     assert "x: locked but no longer extracted" in text
     assert "y: not in PROGRAMS.lock" in text
+
+
+def test_schedule_diff_prints_old_and_new_side_by_side():
+    """A schedule change prints BOTH whole schedules, not only field
+    paths — a reviewer reads 'what was the schedule, what is it now' in
+    two lines (counts + bytes when budgeted)."""
+    locked = {"kind": "collective_schedule", "mesh": {"tp": 2}, "world": 8,
+              "collectives": {"all-gather": 40, "all-reduce": 70},
+              "comm": {"all-gather": {"count": 40,
+                                      "bytes_per_step": 2155872},
+                       "all-reduce": {"count": 70,
+                                      "bytes_per_step": 1048576}},
+              "expect": [], "reduction": True}
+    fresh = dict(locked,
+                 collectives={"all-gather": 42, "all-reduce": 70},
+                 comm={"all-gather": {"count": 42,
+                                      "bytes_per_step": 70254592},
+                       "all-reduce": {"count": 70,
+                                      "bytes_per_step": 1048576}})
+    diff = contract.diff_program("parallel.fake", locked, fresh)
+    text = "\n".join(diff)
+    assert "collectives.all-gather: 40 -> 42" in text
+    # the byte story is the reviewable half of the regression
+    assert "all-gather bytes: 2.1MB -> 67.0MB per step" in text
+    side = [ln for ln in diff if "schedule:" in ln or ln.strip()
+            .startswith("->")]
+    assert len(side) == 2, diff
+    assert "all-gather x40 (2.1MB)" in side[0]
+    assert "all-gather x42 (67.0MB)" in side[1]
+
+
+# ------------------------------------------------------------------ #
+# Comm budgets + mesh-scaling tables (the byte-level contract layer)
+# ------------------------------------------------------------------ #
+def test_lockfile_carries_comm_budgets(lock):
+    """Every locked program carries a comm budget; today's single-chip
+    programs must budget ZERO bytes (a collective appearing in one is a
+    contract break, not a surprise), and every sharding-plan schedule
+    budgets every counted collective with nonzero bytes and matching
+    instance counts."""
+    for name, c in lock["programs"].items():
+        assert "comm" in c, f"{name}: no comm budget locked"
+        assert c["comm"] == {}, \
+            f"{name}: single-chip program budgets {c['comm']}"
+    for name, c in lock["collective_schedules"].items():
+        assert c["world"] == 8, name
+        counts = c["collectives"]
+        budget = c["comm"]
+        assert set(budget) == set(counts), (name, budget, counts)
+        for op, n in counts.items():
+            assert budget[op]["count"] == n, (name, op)
+            assert budget[op]["bytes_per_step"] > 0, (name, op)
+
+
+def test_lockfile_scaling_tables_are_sound(lock):
+    """The locked {1,2,4,8} tables' internal invariants, checked with no
+    compiles: all four plans present with all four mesh points, one chip
+    moves zero bytes, the top row equals the locked schedule's budget
+    (same compile), and every growing collective carries a declared
+    reason (the prover's growth gate on the committed artifact)."""
+    scaling = lock["mesh_scaling"]
+    assert set(scaling) == set(lock["collective_schedules"])
+    for name, sc in scaling.items():
+        worlds = [row["world"] for row in sc["points"]]
+        assert worlds == [1, 2, 4, 8], (name, worlds)
+        assert sc["points"][0]["bytes_per_chip_total"] == 0, \
+            f"{name}: phantom collective traffic on a mesh of one"
+        top = sc["points"][-1]
+        sched = lock["collective_schedules"][name]
+        assert top["collectives"] == sched["comm"], \
+            f"{name}: scaling table top row disagrees with the locked " \
+            f"schedule budget"
+        assert top["mesh"] == sched["mesh"], name
+        problems = comm_contract.validate_scaling_contract(name, sc)
+        assert not problems, "\n".join(problems)
+        # the growth flags themselves are locked: every flagged op is
+        # declared, and nothing is declared "just in case" for ops that
+        # never appear in the table
+        seen_ops = set()
+        for row in sc["points"]:
+            seen_ops |= set(row["bytes_per_chip"])
+        for op in sc["allowed_growth"]:
+            assert op in seen_ops, \
+                f"{name}: allowed_growth for {op!r} which never appears"
+
+
+def test_growth_prover_flags_synthetic_replication():
+    """Unit acceptance for the scaling prover: a per-chip trajectory that
+    GROWS (the replicated-tensor smell) is flagged with a readable
+    transition trail and fails validation unless declared."""
+    table = [
+        comm_contract.scaling_entry(1, {"tp": 1}, {}),
+        comm_contract.scaling_entry(
+            2, {"tp": 2},
+            {"all-gather": {"count": 4, "bytes_per_step": 4 * 2048}}),
+        comm_contract.scaling_entry(
+            4, {"tp": 4},
+            {"all-gather": {"count": 4, "bytes_per_step": 4 * 16384}}),
+    ]
+    flags = comm_contract.growth_flags(table)
+    assert "all-gather" in flags
+    assert "2->4" in flags["all-gather"][0]
+    contract_ = {"kind": "mesh_scaling", "points": table,
+                 "grows_with_mesh": flags, "allowed_growth": {}}
+    problems = comm_contract.validate_scaling_contract("fixture.bad",
+                                                       contract_)
+    assert problems and "GROWS with mesh size" in problems[0]
+    assert "replicated-tensor smell" in problems[0]
+    # a declared reason clears it
+    contract_["allowed_growth"] = {"all-gather": "weak-scaling batch"}
+    assert not comm_contract.validate_scaling_contract("fixture.ok",
+                                                       contract_)
+    # flat-or-falling trajectories stay clean
+    table[2]["bytes_per_chip"]["all-gather"] = 4096
+    assert not comm_contract.growth_flags(table)
+
+
+def test_scaling_diff_renders_bytes_per_chip():
+    """A scaling-table drift diffs readably, per mesh point, in bytes."""
+    a = {"points": [comm_contract.scaling_entry(
+        2, {"tp": 2}, {"all-gather": {"count": 1,
+                                      "bytes_per_step": 2 * 1024}})],
+        "grows_with_mesh": {}, "allowed_growth": {}}
+    b = {"points": [comm_contract.scaling_entry(
+        2, {"tp": 2}, {"all-gather": {"count": 1,
+                                      "bytes_per_step": 2 * 1048576}})],
+        "grows_with_mesh": {}, "allowed_growth": {}}
+    diff = comm_contract.diff_scaling("parallel.fake", a, b)
+    text = "\n".join(diff)
+    assert "mesh 2 all-gather: 1.0KB -> 1.0MB per chip" in text
+    # a drift confined to a declared growth REASON renders the actual
+    # strings, not two identical key lists
+    c = dict(a, allowed_growth={"all-gather": "old reason"})
+    d = dict(a, allowed_growth={"all-gather": "new reason"})
+    text = "\n".join(comm_contract.diff_scaling("parallel.fake", c, d))
+    assert "allowed_growth[all-gather]: 'old reason' -> 'new reason'" \
+        in text
+    # an instance-count drift whose bytes (and hence the truncated
+    # per-chip number) are unchanged still diffs — the locked per-point
+    # schedule entries are compared, not only bytes_per_chip
+    e = {"points": [comm_contract.scaling_entry(
+        2, {"tp": 2}, {"all-gather": {"count": 2,
+                                      "bytes_per_step": 2 * 1024}})],
+        "grows_with_mesh": {}, "allowed_growth": {}}
+    text = "\n".join(comm_contract.diff_scaling("parallel.fake", a, e))
+    assert "mesh 2 all-gather schedule: 1x/2.0KB -> 2x/2.0KB" in text
+
+
+def test_hlo_comm_parser_formats():
+    """The HLO parser handles every replica-group/operand format XLA
+    emits: explicit and iota groups, tuple-shaped variadic all-to-all,
+    async -start (the -done halves never double-count), permute pair
+    lists, and group-free instructions spanning the world."""
+    txt = """
+%ag = f32[4,8]{1,0} all-gather(f32[2,8]{1,0} %c), replica_groups={{0,1},{2,3},{4,5},{6,7}}, dimensions={0}, metadata={op_name="x{y}"}
+%ar = f32[4,16]{1,0} all-reduce-start(f32[4,16]{1,0} %d), replica_groups=[4,2]<=[8], to_apply=%region
+%ard = f32[4,16]{1,0} all-reduce-done(f32[4,16]{1,0} %ar)
+%a2a = (f32[2,8]{1,0}, f32[2,8]{1,0}) all-to-all(f32[2,8]{1,0} %p, f32[2,8]{1,0} %q), replica_groups={{0,1},{2,3}}
+%cp = f32[4,16]{1,0} collective-permute(f32[4,16]{1,0} %e), source_target_pairs={{0,2},{2,4},{4,6},{6,0}}
+%bf = bf16[8]{0} all-reduce(bf16[8]{0} %g), replica_groups={}
+%pm = pred[4,16]{1,0} all-reduce(pred[4,16]{1,0} %m), replica_groups=[4,2]<=[8]
+"""
+    comm = comm_contract.parse_hlo_comm(txt, 8)
+    assert comm["all-gather"] == {"count": 1, "bytes_per_step": 512}
+    # pred is the one digit-free dtype token: 64 bool bytes x 2 x 4
+    assert comm["all-reduce"] == {"count": 3,
+                                  "bytes_per_step": 2048 + 128 + 512}
+    assert comm["all-to-all"] == {"count": 1, "bytes_per_step": 512}
+    assert comm["collective-permute"] == {"count": 1,
+                                          "bytes_per_step": 1024}
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("plan_name",
+                         [b.__name__ for b in __import__(
+                             "deepspeed_tpu.parallel.plans",
+                             fromlist=["PLAN_BUILDERS"]).PLAN_BUILDERS])
+def test_mesh_scaling_matches_lockfile(lock, plan_name):
+    """The full regen-and-diff of one plan's scaling table: compile the
+    scaled-down mesh points {1,2,4} (the 8-point is derived from the
+    locked schedule, whose own fresh compile is proven by
+    test_collective_schedule_matches_lockfile), then validate growth and
+    diff per chip.  ``slow``: three engine compiles per plan; run via
+    ``ds_lint --comm`` or ``-m slow``."""
+    sched_name = f"parallel.{plan_name}"
+    name, fresh = contract.build_plan_scaling_contract(
+        plan_name, full_contract=lock["collective_schedules"][sched_name])
+    problems = comm_contract.validate_scaling_contract(name, fresh)
+    assert not problems, "\n".join(problems)
+    locked = lock["mesh_scaling"].get(name)
+    assert locked is not None, \
+        f"{name} not in {LOCK.name} — run ds_lint --contracts --update"
+    diff = comm_contract.diff_scaling(name, locked, fresh)
+    assert not diff, "mesh-scaling break:\n" + "\n".join(diff)

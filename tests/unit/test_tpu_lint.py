@@ -23,7 +23,8 @@ def lint_fixture(name, rules=None):
 
 @pytest.mark.parametrize("rule_id,expected_min", [
     ("TL001", 7), ("TL002", 3), ("TL003", 4), ("TL004", 2), ("TL005", 2),
-    ("TL006", 9), ("TL007", 4), ("TL008", 6), ("TL009", 5)])
+    ("TL006", 9), ("TL007", 4), ("TL008", 6), ("TL009", 5), ("TL010", 7),
+    ("TL011", 8)])
 def test_rule_positive_fixture(rule_id, expected_min):
     findings, _ = lint_fixture(f"{rule_id.lower()}_positive.py")
     hits = [f for f in findings if f.rule == rule_id]
@@ -33,7 +34,8 @@ def test_rule_positive_fixture(rule_id, expected_min):
 
 @pytest.mark.parametrize("rule_id",
                          ["TL001", "TL002", "TL003", "TL004", "TL005",
-                          "TL006", "TL007", "TL008", "TL009"])
+                          "TL006", "TL007", "TL008", "TL009", "TL010",
+                          "TL011"])
 def test_rule_negative_fixture(rule_id):
     findings, _ = lint_fixture(f"{rule_id.lower()}_negative.py")
     hits = [f for f in findings if f.rule == rule_id]
@@ -68,7 +70,7 @@ def test_cli_list_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("TL001", "TL002", "TL003", "TL004", "TL005", "TL006",
-                "TL007", "TL008", "TL009"):
+                "TL007", "TL008", "TL009", "TL010", "TL011"):
         assert rid in out
 
 
@@ -110,6 +112,40 @@ def test_cli_stats_docs_gate_green_and_detects_drift(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "decode_tokens" in out and "dstpu_serving_ttft_seconds" in out
     capsys.readouterr()
+
+
+def test_cli_comm_exits_nonzero_on_sweep_finding(capsys):
+    """`ds_lint --comm` on a source tree with an unsuppressed replicated
+    spec must exit 1 from the STATIC sweep (the mesh-scaling prover is
+    skipped once the sweep is dirty)."""
+    assert lint_main(["--comm", str(FIXTURES / "tl010_positive.py")]) == 1
+    out = capsys.readouterr().out
+    assert "TL010" in out and "tpu-lint[comm]" in out
+
+
+def test_cli_comm_synthetic_replication_break(capsys, monkeypatch):
+    """Acceptance: `ds_lint --comm` exits 1 on a synthetic replication
+    break — a fixture plan whose replicated batch weak-scales with the
+    mesh compiles at {1,2,4}, its per-chip all-reduce volume grows, and
+    the prover fails READABLY (op, transitions, the smell, the fix)."""
+    monkeypatch.setenv("DSTPU_COMM_PLANS_MODULE",
+                       str(FIXTURES / "comm_fixture_plans.py"))
+    assert lint_main(["--comm", str(FIXTURES / "tl010_negative.py")]) == 1
+    out = capsys.readouterr().out
+    assert "GROWS with mesh size" in out
+    assert "fixture.replicated_batch" in out
+    assert "replicated-tensor smell" in out
+    assert "allowed_growth" in out
+
+
+def test_tl011_canonical_axes_mirror_topology():
+    """TL011's axis literal set is a pure-data mirror of the topology's
+    AXIS_ORDER (the linter never imports the code under analysis) — this
+    is the registry-matches-engine test keeping the two in lockstep."""
+    from deepspeed_tpu.parallel.topology import AXIS_ORDER
+    from deepspeed_tpu.tools.lint.rules.tl011_resharding_seams import \
+        _CANONICAL_AXES
+    assert _CANONICAL_AXES == AXIS_ORDER
 
 
 def test_cli_concurrency_clean_paths_reach_the_prover(capsys, monkeypatch):

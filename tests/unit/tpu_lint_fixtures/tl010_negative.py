@@ -1,0 +1,48 @@
+"""TL010 negative fixture — sharded and deliberately-scalar placements
+that must NOT be flagged: full specs, P() on scalars, pallas in_specs
+(BlockSpecs, not shardings), and sharded placements of batch arrays."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+mesh = Mesh(jax.devices(), ("tp",))
+
+
+def body(x, w):
+    return x @ w
+
+
+# fully specced: batch sharded, weights tp-sharded
+smap_ok = shard_map(body, mesh=mesh,
+                    in_specs=(P("tp"), P(None, "tp")),
+                    out_specs=P("tp"))
+
+
+# P() on SCALAR control inputs is the correct spec, not replication debt
+@functools.partial(shard_map, mesh=mesh,
+                   in_specs=(P("tp"), P(), P()), out_specs=P("tp"))
+def stepper(x, lr, step):
+    return x * lr + step
+
+
+def pallas_like(kernel, block):
+    # pallas_call's in_specs are BlockSpecs — no mesh, not a sharding
+    return pallas_call(kernel, in_specs=[block, block], out_specs=block)
+
+
+def run_under_mesh(batch):
+    with mesh:
+        # shardings declared: inputs follow the committed layout
+        step = jax.jit(lambda b: b * 2, out_shardings=NamedSharding(
+            mesh, P("tp")))
+        return step(batch)
+
+
+def place(input_ids, scale):
+    # batch array sharded; the scalar config value replicates by design
+    ids = jax.device_put(input_ids, NamedSharding(mesh, P("tp")))
+    s = jax.device_put(scale, NamedSharding(mesh, P()))
+    return ids, s

@@ -1,0 +1,63 @@
+"""TL010 positive fixture — implicit replication at mesh boundaries.
+
+Every construct here should be flagged: unspecced shard_maps, bare P()
+specs on batch/sequence-scaling arguments (call, decorator, and
+spec-variable forms), a sharding-free jit under a mesh context, and
+explicit replicated placements of batch-scaling arrays."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+mesh = Mesh(jax.devices(), ("tp",))
+
+
+def body(x, w):
+    return x @ w
+
+
+# (a) mesh but no specs at all: every operand replicates
+smap_unspecced = shard_map(body, mesh=mesh)
+
+# (a) in_specs without out_specs: the OUTPUT replicates
+smap_half = shard_map(body, mesh=mesh, in_specs=(P("tp"), P(None, "tp")))
+
+
+# (b) bare P() bound to the batch-scaling first argument at a call site
+smap_replicated = shard_map(body, mesh=mesh,
+                            in_specs=(P(), P(None, "tp")),
+                            out_specs=P())
+
+
+# (b) decorator form: the hidden activations replicate
+@functools.partial(shard_map, mesh=mesh,
+                   in_specs=(P(), P("tp")), out_specs=P())
+def region(hidden, w):
+    return hidden * w
+
+
+def stage(acts, params):
+    return acts @ params
+
+
+# (b) spec-variable indirection: same replication, one assignment away
+in_specs = (P(), P(None, "tp"))
+smap_indirect = shard_map(stage, mesh=mesh, in_specs=in_specs,
+                          out_specs=P())
+
+
+def run_under_mesh(batch):
+    # (a2) jit in a mesh context with no shardings anywhere
+    with mesh:
+        step = jax.jit(lambda b: b * 2)
+        return step(batch)
+
+
+def place(input_ids, logits):
+    # (b2) replicated placement of batch-scaling arrays
+    rep = NamedSharding(mesh, P())
+    ids = jax.device_put(input_ids, rep)
+    out = jax.lax.with_sharding_constraint(logits, NamedSharding(mesh, P()))
+    return ids, out

@@ -1,0 +1,42 @@
+"""TL011 negative fixture — placements at setup time, canonical axis
+names, and variable axis names (out of static reach by design)."""
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from deepspeed_tpu.tools.lint.hotpath import hot_path
+
+mesh = Mesh(jax.devices(), ("tp",))
+
+
+def build_engine(params, batch):
+    # placement at SETUP time is where it belongs — not a hot path
+    params = jax.device_put(params, NamedSharding(mesh, P("tp")))
+    batch = jax.device_put(batch, NamedSharding(mesh, P("edp")))
+    return params, batch
+
+
+@hot_path("fixture.clean_step")
+def clean_step(params, cache, token):
+    return apply(params, cache, token)
+
+
+def body(x, w):
+    return x @ w
+
+
+# canonical topology axes, including compound specs
+smap_ok = shard_map(body, mesh=mesh,
+                    in_specs=(P(("edp", "ep")), P(None, "tp")),
+                    out_specs=P("sp"))
+
+
+def reduce_over(x, axis):
+    # variable axis names resolve at runtime from the topology helpers
+    y = jax.lax.psum(x, axis)
+    return jax.lax.all_gather(y, axis_name=axis)
+
+
+def reduce_canonical(x):
+    return jax.lax.psum(x, "tp")

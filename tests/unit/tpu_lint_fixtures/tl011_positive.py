@@ -1,0 +1,48 @@
+"""TL011 positive fixture — implicit resharding seams.
+
+Mid-step placement changes inside hot paths (direct, via helper, and the
+constraint form) and literal mesh-axis names the canonical topology does
+not define (shard_map specs and traced collectives)."""
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from deepspeed_tpu.tools.lint.hotpath import hot_path
+
+mesh = Mesh(jax.devices(), ("tp",))
+
+
+@hot_path("fixture.decode_step")
+def decode_step(params, cache, token):
+    # a mid-step reshard: host-synchronized, in no locked comm budget
+    cache = jax.device_put(cache, NamedSharding(mesh, P("tp")))
+    logits = apply(params, cache, token)
+    out = jax.lax.with_sharding_constraint(logits, P("tp"))
+    return out
+
+
+def _respill(grads):
+    # flagged through hot reachability: called from the hot train step
+    return jax.device_put(grads, NamedSharding(mesh, P("tp")))
+
+
+@hot_path("fixture.train_step")
+def train_step(params, grads):
+    return _respill(grads)
+
+
+def body(x, w):
+    return x @ w
+
+
+# axis names the canonical topology (pp/mdp/edp/ep/sp/tp) does not define
+smap_bad_axis = shard_map(body, mesh=mesh,
+                          in_specs=(P("dp"), P(None, "model")),
+                          out_specs=P(("data", "model")))
+
+
+def reduce_over(x):
+    y = jax.lax.psum(x, "model")
+    z = jax.lax.all_gather(x, axis_name="shard")
+    return y + z

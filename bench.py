@@ -248,6 +248,114 @@ def calibrate_bench():
     }
 
 
+def memory_snapshot_bench(fallback=False):
+    """Per-program memory & roofline micro-phase (the r05-blackout
+    lesson applied to the MEMORY record: cheap, pinned right behind
+    calibration, so per-program HBM numbers commit even in rounds whose
+    budget dies before the heavy phases).
+
+    For every contract-locked hot-path program (the tier-1 entry-point
+    builders — toy shapes, exact compiler budgets): compile, extract
+    ``compiled.memory_analysis()`` + ``cost_analysis()`` through the
+    same shared cost model ``PROGRAMS.lock`` format 3 locks, time a few
+    executions, and derive the roofline block — achieved FLOP/s,
+    achieved GB/s, arithmetic intensity, memory-bound/compute-bound —
+    against the calibration phase's measured peaks (datasheet when
+    calibration hasn't run or was implausible).  Wall times at toy
+    shapes include host dispatch, so the achieved fractions are floors;
+    the intensity and bound classification are timing-independent."""
+    import jax
+    from deepspeed_tpu.parallel.topology import reset_topology
+    from deepspeed_tpu.profiling.roofline import (device_peaks,
+                                                  roofline_block)
+    from deepspeed_tpu.tools.lint import mem_contract
+
+    meas_t, meas_g = _measured_peaks()
+    peak_t, peak_g, peak_src = device_peaks(meas_t, meas_g)
+
+    def _copy(x):
+        try:
+            return x.copy()
+        except Exception:
+            return x
+
+    want = os.environ.get("BENCH_MEMSNAP_PROGRAMS")
+    want = {w.strip() for w in want.split(",") if w.strip()} if want \
+        else None
+    fallback_keep = {"inference_decode", "serving_decode_step",
+                     "serving_admit"}
+    programs, errors = {}, {}
+    matched = set()
+    # the name filter + builder->program map discipline is shared with
+    # ds_lint --mem (mem_contract.filtered_builders): subset runs skip
+    # the engine builds of filtered-out programs, and the map is
+    # cross-checked against what each builder actually constructs
+    for build, mapped in mem_contract.filtered_builders(want):
+        if fallback and build.__name__ not in fallback_keep:
+            # safe-config retry: the three cheapest engine builds
+            # still commit a usable memory record
+            continue
+        reset_topology()
+        try:
+            ep = build()
+            drift = mem_contract.map_drift_problem(build.__name__,
+                                                   mapped, ep.name)
+            if drift:
+                errors[build.__name__] = drift
+            if want and ep.name not in want:
+                continue
+            # matched BEFORE compiling: a matched program whose compile
+            # fails is a program_errors entry, not a "misspelled name"
+            matched.add(ep.name)
+            # cache-bypassed: a persistent-cache reload (bench runs with
+            # the compile cache on) reports degenerate alias bytes
+            with mem_contract.fresh_compile_env():
+                compiled = ep.fn.lower(*ep.args).compile()
+            rec = mem_contract.memory_cost_of(compiled)
+            # timed execution: donated buffers die per call, so every
+            # rep runs on fresh copies; median rejects dispatch jitter
+            times = []
+            for _ in range(3):
+                args = jax.tree.map(_copy, ep.args)
+                t0 = time.perf_counter()
+                jax.block_until_ready(compiled(*args))
+                times.append(time.perf_counter() - t0)
+            wall = float(np.median(times))
+            programs[ep.name] = {
+                "memory": rec["memory"],
+                "cost": rec["cost"],
+                "roofline": roofline_block(
+                    rec["cost"]["flops"], rec["cost"]["bytes_accessed"],
+                    wall, peak_t, peak_g, peak_src),
+            }
+        except Exception as e:               # one sick program must not
+            errors[build.__name__] = f"{type(e).__name__}: {e}"[:300]
+        finally:                             # erase the others' numbers
+            reset_topology()
+    result = {
+        "programs": programs,
+        "n_programs": len(programs),
+        "peaks": {"tflops": peak_t, "gbps": peak_g, "source": peak_src},
+        "shapes": "tier-1 contract entry points (toy): budgets exact, "
+                  "wall times include host dispatch",
+        # the per-phase hbm_watermark is stamped centrally by run_phase
+        # (device_memory_record) like every other phase
+    }
+    if want:
+        # a misspelled subset name must fail LOUDLY, not thin the
+        # record silently (ds_lint --mem enforces the same rule)
+        unmatched = want - matched
+        if unmatched:
+            errors["unmatched_names"] = (
+                f"BENCH_MEMSNAP_PROGRAMS name(s) {sorted(unmatched)} "
+                f"matched no program — nothing was recorded for them")
+    if errors:
+        result["program_errors"] = errors
+    if not programs:
+        result["error"] = f"no program produced a memory record: {errors}"
+    return result
+
+
 def train_bench(model_name, *, micro_bs, zero_stage, steps, seq=2048,
                 lean=False, remat=False, remat_policy="dots_and_attn_saveable",
                 scan_layers=False, fused_qkv=False, loss_chunks=8,
@@ -426,8 +534,23 @@ def decode_bench(model_name="opt-1.3b", *, batch_size=16, prompt=256,
         _, meas_gbps = _measured_peaks()
         hbm_util_meas = traffic / step_t / (meas_gbps * 1e9) \
             if meas_gbps else None
+        # roofline attribution (docs/observability.md "Device memory &
+        # roofline"): per-chip decode-step flops ~ 2 x params x the
+        # chip's batch shard (matmul-dominated), bytes = the same
+        # traffic estimate hbm_utilization uses — the classification
+        # says WHY a cliff happened (a decode step left of the ridge is
+        # bandwidth-ceilinged: HBM traffic regressions cut throughput
+        # linearly no matter how idle the MXU is)
+        from deepspeed_tpu.profiling.roofline import (device_peaks,
+                                                      roofline_block)
+        param_count = sum(int(np.prod(l.shape))
+                          for l in jax.tree.leaves(eng.params))
+        flops_step = 2.0 * param_count * batch_size / jax.device_count()
+        peak_t, peak_g, peak_src = device_peaks(*_measured_peaks())
+        roofline = roofline_block(flops_step, traffic, step_t,
+                                  peak_t, peak_g, peak_src)
     else:
-        decode_rate, hbm_util, hbm_util_meas = None, None, None
+        decode_rate, hbm_util, hbm_util_meas, roofline = (None,) * 4
         error = (f"timing inversion persisted across re-measure "
                  f"(gen={gen}: {dt_full:.3f}s <= gen={gen // 2}: "
                  f"{dt_half:.3f}s) — decode rate not measurable")
@@ -450,6 +573,8 @@ def decode_bench(model_name="opt-1.3b", *, batch_size=16, prompt=256,
     }
     if hbm_util_meas:
         result["hbm_utilization_vs_measured"] = round(hbm_util_meas, 3)
+    if roofline:
+        result["roofline"] = roofline
     if error:
         result["error"] = error
     return result
@@ -1381,6 +1506,12 @@ PHASES = [
     # the persistent compile cache its cold compile happens exactly once
     # per machine.
     ("calibration", "calibrate", lambda fb: calibrate_bench()),
+    # per-program memory & roofline record — pinned cheap-first right
+    # behind calibration (whose measured peaks anchor its rooflines):
+    # the memory record commits even in rounds that die before the
+    # heavy phases (the r05-blackout lesson on the memory axis)
+    ("memory_snapshot", "memory_snapshot",
+     lambda fb: memory_snapshot_bench(fallback=fb)),
     ("sft_350m_guard", "guard", _guard),
     ("__headline__", "north", _north),
     # the offload/NVMe tier, measured against the same in-HBM workload
@@ -1576,8 +1707,10 @@ def _phase_order(phases):
     is measured at least every ceil(n/k) rounds instead of the same k
     forever, and because the incremental record is rewritten after every
     phase, each round's partial record stays a valid final-format record
-    of whatever its budget afforded.  Calibration is pinned first: later
-    phases anchor their roofline math to its measured peaks."""
+    of whatever its budget afforded.  Calibration is pinned first (later
+    phases anchor their roofline math to its measured peaks) and the
+    memory_snapshot micro-phase right behind it (the per-program memory
+    record must commit before any heavy phase can starve it)."""
     trail = _round_trail()
 
     def staleness(key):
@@ -1586,10 +1719,13 @@ def _phase_order(phases):
                 return age
         return len(trail) + 1
 
+    pinned = ("calibrate", "memory_snapshot")
     index = {p[0]: i for i, p in enumerate(phases)}
-    rest = sorted((p for p in phases if p[1] != "calibrate"),
+    rest = sorted((p for p in phases if p[1] not in pinned),
                   key=lambda p: (-staleness(p[0]), index[p[0]]))
-    return [p for p in phases if p[1] == "calibrate"] + rest
+    head = sorted((p for p in phases if p[1] in pinned),
+                  key=lambda p: pinned.index(p[1]))
+    return head + rest
 
 
 # --------------------------------------------------------------------- #
@@ -1602,10 +1738,18 @@ def _regression_direction(key):
     """+1 = higher is better, -1 = lower is better, 0 = not a perf metric."""
     if "tokens_per_sec" in key or "tok_s" in key or key == "mfu" \
             or key.startswith("speedup") or key.endswith("_efficiency") \
-            or "accept_rate" in key or key == "tokens_per_dispatch":
+            or "accept_rate" in key or key == "tokens_per_dispatch" \
+            or key in ("achieved_gbps", "achieved_tflops") \
+            or key.startswith("hbm_utilization") \
+            or key.endswith("_fraction_of_peak"):
         return 1
     if key in ("step_time_s", "e2e_time_s") or "ttft_" in key \
-            or "time_between_tokens" in key or key.startswith("lock_wait_"):
+            or "time_between_tokens" in key or key.startswith("lock_wait_") \
+            or key in ("temp_size_in_bytes", "total_bytes",
+                       "hbm_unattributed_bytes"):
+        # roofline regressions: a program's achieved bandwidth/compute
+        # falling, or its temp/live HBM budget growing, is exactly the
+        # bs128-cliff class the memory record exists to flag
         return -1
     return 0
 
@@ -1690,6 +1834,15 @@ def run_phase(name, fallback, out_path):
         result["fallback"] = True
     # compile cost observability: how much this phase compiled vs reloaded
     result["compile_cache"] = _cache_report(before)
+    # per-phase peak-HBM watermark (docs/observability.md "Device memory
+    # & roofline"): each phase owns its subprocess, so the accelerator's
+    # process-lifetime peak IS the phase watermark.  Best-effort — a
+    # backend with no live stats still records the (zero) shape
+    try:
+        from deepspeed_tpu.monitor.memwatch import device_memory_record
+        result.setdefault("hbm_watermark", device_memory_record())
+    except Exception as e:
+        result.setdefault("hbm_watermark", {"error": str(e)[:200]})
     with open(out_path, "w") as f:
         json.dump(result, f)
 

@@ -123,6 +123,31 @@ class Accelerator(ABC):
     def available_memory(self, device_index=None):
         ...
 
+    def memory_snapshot(self, device_index=None):
+        """The canonical normalized per-device memory view every
+        device-memory consumer reads through (``see_memory_usage``, the
+        flops profiler's budget, the autotuner's cost model, the
+        serving memory sampler, bench watermarks): ``{device, platform,
+        bytes_in_use, peak_bytes_in_use, bytes_limit, limit_source}``.
+        The base implementation normalizes :meth:`memory_stats`;
+        ``TPU_Accelerator`` refines ``bytes_limit`` with the datasheet
+        capacity when the backend reports none."""
+        stats = self.memory_stats(device_index)
+        limit = int(stats.get("bytes_limit") or 0)
+        return {
+            "device": self.device_name(device_index or 0),
+            "platform": self._name,
+            "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
+            "bytes_limit": limit,
+            "limit_source": "runtime" if limit else "unknown",
+        }
+
+    def memory_snapshots(self):
+        """One :meth:`memory_snapshot` per local device."""
+        return [self.memory_snapshot(i)
+                for i in range(self.device_count())]
+
     # ------------------------------------------------------------------ #
     # Dtype support
     # ------------------------------------------------------------------ #

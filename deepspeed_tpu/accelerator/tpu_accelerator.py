@@ -11,6 +11,31 @@ import jax.numpy as jnp
 
 from .abstract_accelerator import Accelerator
 
+# Datasheet HBM capacity per chip in bytes (public figures) — the
+# fallback ``bytes_limit`` when the backend reports no
+# ``memory_stats()['bytes_limit']`` (tunneled/relay PJRT platforms and
+# the CPU test backend return empty/None stats).  0 = unknown/unbounded
+# (host RAM is not a fixed budget); callers should skip budget checks.
+DATASHEET_HBM_BYTES = {
+    "tpu v4": int(32.0e9),
+    "tpu v5 lite": int(16.0e9),     # v5e
+    "tpu v5e": int(16.0e9),
+    "tpu v5": int(96.0e9),          # v5p
+    "tpu v6 lite": int(32.0e9),     # trillium
+    "cpu": 0,
+}
+
+
+def datasheet_hbm_bytes(device=None):
+    """Datasheet HBM capacity for ``device`` (default: device 0), keyed
+    by its ``device_kind`` prefix; 0 when unknown."""
+    d = device if device is not None else jax.devices()[0]
+    kind = getattr(d, "device_kind", "cpu").lower()
+    for key, val in DATASHEET_HBM_BYTES.items():
+        if kind.startswith(key):
+            return val
+    return DATASHEET_HBM_BYTES.get(d.platform, 0)
+
 
 class TPU_Accelerator(Accelerator):
 
@@ -80,11 +105,28 @@ class TPU_Accelerator(Accelerator):
         except Exception:
             stats = {}
         in_use = stats.get("bytes_in_use", 0)
-        peak = self._peak_bytes.get(dev.id, 0)
+        peak = max(self._peak_bytes.get(dev.id, 0),
+                   stats.get("peak_bytes_in_use", 0))
         if in_use > peak:
-            self._peak_bytes[dev.id] = peak = in_use
+            peak = in_use
+        self._peak_bytes[dev.id] = peak
         stats.setdefault("peak_bytes_in_use", peak)
         return stats
+
+    def memory_snapshot(self, device_index=None):
+        """The base normalization (one canonical reader — see the ABC
+        docstring) refined with the datasheet fallback: when the
+        backend reports no live ``bytes_limit`` (the CPU test backend,
+        tunneled PJRT), the budget falls back to the datasheet
+        capacity for the device kind, ``limit_source`` labeled
+        ``"datasheet"`` (``"unknown"`` when the kind isn't tabled)."""
+        snap = super().memory_snapshot(device_index)
+        if not snap["bytes_limit"]:
+            dev = self.devices()[device_index or 0]
+            limit = datasheet_hbm_bytes(dev)
+            snap["bytes_limit"] = limit
+            snap["limit_source"] = "datasheet" if limit else "unknown"
+        return snap
 
     def memory_allocated(self, device_index=None):
         return self.memory_stats(device_index).get("bytes_in_use", 0)

@@ -17,17 +17,21 @@ from deepspeed_tpu.autotuning.constants import DEFAULT_HBM_BYTES
 def device_memory_limit():
     """Per-chip memory budget in bytes.
 
-    Order: ``DSTPU_HBM_BYTES`` env override → ``memory_stats()['bytes_limit']``
-    (real TPU) → conservative default.
+    Order: ``DSTPU_HBM_BYTES`` env override → the accelerator's
+    canonical ``memory_snapshot()['bytes_limit']`` (backend-reported on
+    real TPU, datasheet fallback on tunneled platforms — the SAME
+    number the flops profiler and the serving memory sampler read) →
+    conservative default.
     """
     env = os.environ.get("DSTPU_HBM_BYTES")
     if env:
         return int(env)
-    import jax
     try:
-        stats = jax.local_devices()[0].memory_stats()
-        if stats and stats.get("bytes_limit"):
-            return int(stats["bytes_limit"])
+        from deepspeed_tpu.accelerator.real_accelerator import \
+            get_accelerator
+        limit = int(get_accelerator().memory_snapshot()["bytes_limit"])
+        if limit:
+            return limit
     except Exception:
         pass
     return DEFAULT_HBM_BYTES
@@ -58,10 +62,14 @@ def estimate_zero_memory(num_params,
 
 
 def xla_memory_analysis(compiled):
-    """Exact compile-time memory of a lowered+compiled XLA program.
-
-    Returns a dict of byte counts, or ``None`` when the backend does not
-    expose the analysis (e.g. the CPU test backend).
+    """Exact compile-time memory of a lowered+compiled XLA program
+    (``compiled.memory_analysis()``): argument / output / temp / alias /
+    generated-code bytes, plus ``total_bytes`` = arg + out + temp −
+    alias (the program's live working set — what it actually costs the
+    device on top of buffers it aliases in place).  Exact on TPU,
+    stable on the tier-1 CPU backend (the memory/FLOP contracts in
+    ``PROGRAMS.lock`` are locked from this).  Returns ``None`` when the
+    backend does not expose the analysis.
     """
     try:
         ma = compiled.memory_analysis()
@@ -79,13 +87,33 @@ def xla_memory_analysis(compiled):
         return None
 
 
-def xla_flops_analysis(compiled):
-    """XLA's own flop estimate for the program (feeds the FLOPS metric)."""
+def xla_cost_analysis(compiled):
+    """XLA's raw cost-analysis dict for a compiled program, normalized
+    to a plain dict (some backends return a one-element list).  Keys of
+    interest: ``'flops'`` and ``'bytes accessed'`` — THE shared cost
+    model: the flops profiler, the memory/FLOP program contracts
+    (``tools/lint/mem_contract.py``) and the bench roofline blocks all
+    read compiled programs through this one extraction."""
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
-        flops = ca.get("flops", 0.0) if hasattr(ca, "get") else 0.0
-        return float(flops)
+        return dict(ca) if hasattr(ca, "get") else {}
     except Exception:
-        return 0.0
+        return {}
+
+
+def xla_flops_analysis(compiled):
+    """XLA's own flop estimate for the program (feeds the FLOPS metric)."""
+    return float(xla_cost_analysis(compiled).get("flops", 0.0))
+
+
+def compiled_costs(compiled):
+    """``{"flops", "bytes_accessed", "transcendentals"}`` (floats) from
+    a compiled program's cost analysis — the roofline numerators."""
+    ca = xla_cost_analysis(compiled)
+    return {
+        "flops": float(ca.get("flops", 0.0)),
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+        "transcendentals": float(ca.get("transcendentals", 0.0)),
+    }

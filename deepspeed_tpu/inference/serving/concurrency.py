@@ -96,6 +96,10 @@ GUARDED_FIELDS = {
         # observability: the span tracer's ring is appended to at the
         # scheduler seams (lock-held) and copied whole by dump_trace()
         "_tracer": "_lock",
+        # the device-memory sampler mutates its cadence/last-sample
+        # state at the same scheduler seam stats is grown at (the
+        # /metrics render reads its .last snapshot under the lock too)
+        "_memwatch": "_lock",
     },
 }
 

@@ -173,3 +173,18 @@ class ServingConfig(DeepSpeedConfigModel):
     # (Perfetto/TensorBoard-loadable).  Off by default: profiling is a
     # debug affordance, not a production endpoint
     profile_endpoint: bool = False
+    # live device-memory telemetry (docs/observability.md, "Device
+    # memory & roofline"): a host-side sampler reads per-device
+    # bytes_in_use/peak/limit through the accelerator's canonical
+    # memory reader at scheduler seams, reconciles the engine's known
+    # owners (page pool, KV/draft workspaces, params, lanes, slot
+    # state) against the device total into an unattributed-bytes gap,
+    # exports dstpu_device_memory_* gauges on /metrics, records
+    # memory_sample events in the flight-recorder ring (when that is
+    # on), and stamps a peak-HBM watermark into stats.  Host-side only
+    # — zero new executables, outputs bitwise-identical either way.
+    # Default off = seed behavior
+    memory_telemetry: bool = False
+    # seconds between memory samples (a clock compare between samples;
+    # each sample is one PJRT memory_stats() host call per device)
+    memory_sample_interval_s: float = 10.0

@@ -594,6 +594,7 @@ class ServingEngine:
             self._tracer = None          # guarded-by: _lock
             self._hist = None
         self._inject_observer = None
+        self._memwatch = None            # guarded-by: _lock
         if cfg.flight_recorder:
             # the ring is guarded by its OWN lock (flightrec.py): the
             # hot path appends without contending readers, and crash
@@ -609,6 +610,22 @@ class ServingEngine:
                     hit=hit))
         else:
             self._flightrec = None
+        if cfg.memory_telemetry:
+            # live HBM telemetry (docs/observability.md "Device memory
+            # & roofline"): host-side sampler over the accelerator's
+            # canonical memory reader, owner-reconciled against this
+            # engine's known buffers; rides the flight recorder when
+            # that is on.  Zero new executables — memory_stats() is a
+            # PJRT host call
+            from deepspeed_tpu.monitor.memwatch import DeviceMemorySampler
+            self._memwatch = DeviceMemorySampler(
+                interval_s=float(cfg.memory_sample_interval_s),
+                owners_fn=self._device_memory_owners,
+                flightrec=self._flightrec)
+            self.stats.update({
+                "hbm_bytes_in_use": 0, "hbm_peak_bytes": 0,
+                "hbm_limit_bytes": 0, "hbm_owned_bytes": 0,
+                "hbm_unattributed_bytes": 0, "memory_samples": 0})
         # classify lock waiters as scheduler vs handler; the ref is read
         # AFTER a successful acquire, i.e. lock-held (concurrency.py)
         self._lock._owner_ref = \
@@ -820,6 +837,64 @@ class ServingEngine:
         set (``None`` with ``serving.tracing`` off).  Internally locked
         — ``/metrics`` renders it without the engine lock."""
         return self._hist
+
+    # ------------------------------------------------------------------ #
+    # Device-memory telemetry (docs/observability.md "Device memory &
+    # roofline") — host-side, serving.memory_telemetry, default off
+    # ------------------------------------------------------------------ #
+    def _device_memory_owners(self):  # lock-held: _lock
+        """Bytes of every device buffer this engine can NAME — what the
+        sampler reconciles against the accelerator-reported device
+        total; the gap is the unattributed-bytes gauge.  Owner figures
+        are ``nbytes`` sums (no device sync)."""
+        from deepspeed_tpu.monitor.memwatch import tree_device_bytes
+        owners = {"params": tree_device_bytes(self.engine._params)}
+        key = "page_pool" if self.paged else "kv_slots"
+        owners[key] = tree_device_bytes(self._cache)
+        owners["slot_state"] = tree_device_bytes(self._state)
+        lanes = tree_device_bytes(self._lane_pool._lanes)
+        if self._pending is not None:
+            lanes += tree_device_bytes(self._pending.lane)
+        owners["prefill_lanes"] = lanes
+        if self.speculative:
+            owners["draft_kv"] = tree_device_bytes(self._draft_cache) \
+                + tree_device_bytes(self._draft_lanes._lanes)
+            if self._draft_params is not self.engine._params:
+                owners["draft_params"] = \
+                    tree_device_bytes(self._draft_params)
+        return owners
+
+    def _sample_memory(self):  # lock-held: _lock
+        """The scheduler-seam sampling hook: interval-gated; folds the
+        newest sample into ``stats`` (peak is monotone — the serving
+        run's HBM watermark)."""
+        if self._memwatch is None:
+            return
+        sample = self._memwatch.maybe_sample()
+        if sample is not None:
+            self._sample_memory_into_stats(sample)
+
+    def memory_snapshot(self):
+        """One locked on-demand device-memory sample (owner-reconciled)
+        — ``None`` with ``serving.memory_telemetry`` off.  Thread-safe;
+        ``/metrics`` renders the gauges from this."""
+        with self._lock:
+            if self._memwatch is None:
+                return None
+            sample = self._memwatch.sample()
+            self._sample_memory_into_stats(sample)
+            return sample
+
+    def _sample_memory_into_stats(self, sample):  # lock-held: _lock
+        st = self.stats
+        st["hbm_bytes_in_use"] = sample["bytes_in_use"]
+        st["hbm_peak_bytes"] = max(st["hbm_peak_bytes"],
+                                   sample["peak_bytes_in_use"],
+                                   sample["bytes_in_use"])
+        st["hbm_limit_bytes"] = sample["bytes_limit"]
+        st["hbm_owned_bytes"] = sample["owned_bytes"]
+        st["hbm_unattributed_bytes"] = sample["unattributed_bytes"]
+        st["memory_samples"] = self._memwatch.samples
 
     @property
     def flightrec_enabled(self):
@@ -1400,6 +1475,9 @@ class ServingEngine:
                 "lock_wait",
                 scheduler_s=round(self.stats["lock_wait_scheduler_s"], 6),
                 handler_s=round(self.stats["lock_wait_handler_s"], 6))
+        # interval-gated device-memory sample (serving.memory_telemetry;
+        # a clock compare between samples)
+        self._sample_memory()
         self._emit_metrics()
         self.stats["iterations"] += 1
         self.stats["wall_secs"] += time.perf_counter() - t0
@@ -2828,6 +2906,13 @@ class ServingEngine:
              self._it),
             ("Serving/prefix_hit_rate", self.prefix_hit_rate, self._it),
         ] if self.paged else []) + ([
+            ("Serving/hbm_bytes_in_use",
+             self.stats["hbm_bytes_in_use"], self._it),
+            ("Serving/hbm_peak_bytes",
+             self.stats["hbm_peak_bytes"], self._it),
+            ("Serving/hbm_unattributed_bytes",
+             self.stats["hbm_unattributed_bytes"], self._it),
+        ] if self._memwatch is not None else []) + ([
             ("Serving/spec_accept_rate",
              self.stats["spec_accept_rate"], self._it),
             ("Serving/spec_tokens_per_dispatch",

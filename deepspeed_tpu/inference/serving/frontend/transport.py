@@ -680,6 +680,15 @@ class ServingHTTPFrontend:
         (``tests/unit/test_serving_trace.py``)."""
         srv = self.srv
         with srv._lock:
+            mem = None
+            if srv._memwatch is not None:
+                # the scheduler seam owns the sampling cadence; the
+                # scrape only forces a sample when none exists yet (a
+                # server scraped before its first step)
+                mem = srv._memwatch.last
+                if mem is None:
+                    mem = srv._memwatch.sample()
+                    srv._sample_memory_into_stats(mem)
             stats = dict(srv.stats)
             lock_wait = dict(srv._lock.wait_s)
             snap = {
@@ -693,6 +702,8 @@ class ServingHTTPFrontend:
                 else sorted(srv._fairness.window_usage().items()),
                 "fairness_budget": None if srv._fairness is None
                 else srv._fairness.budget,
+                # serving.memory_telemetry: the newest interval sample
+                "memory": mem,
             }
         hist = srv.histograms()          # internally locked; may be None
         lines = []
@@ -746,6 +757,39 @@ class ServingHTTPFrontend:
                     for key, bal in snap["fairness"]])
             gauge("fairness_budget", snap["fairness_budget"],
                   "window budget above which submit() is 429'd")
+        if snap["memory"] is not None:
+            # serving.memory_telemetry (docs/observability.md "Device
+            # memory & roofline"): per-device in-use/peak/limit from the
+            # accelerator's canonical reader, the engine's owner
+            # reconciliation, and the unattributed gap — the family
+            # names are the memwatch.MEMORY_SERIES literal the
+            # stats-docs gate pins to the docs
+            mem = snap["memory"]
+            series("dstpu_device_memory_bytes_in_use",
+                   "device bytes in use (accelerator memory_snapshot)",
+                   "gauge",
+                   [("", {"device": d["device"]}, d["bytes_in_use"])
+                    for d in mem["devices"]])
+            series("dstpu_device_memory_peak_bytes",
+                   "peak device bytes in use since process start",
+                   "gauge",
+                   [("", {"device": d["device"]}, d["peak_bytes_in_use"])
+                    for d in mem["devices"]])
+            series("dstpu_device_memory_limit_bytes",
+                   "device memory budget (runtime bytes_limit or "
+                   "datasheet capacity; 0 = unknown)", "gauge",
+                   [("", {"device": d["device"],
+                          "source": d["limit_source"]},
+                     d["bytes_limit"]) for d in mem["devices"]])
+            series("dstpu_device_memory_owned_bytes",
+                   "bytes attributed to a known serving-engine owner",
+                   "gauge",
+                   [("", {"owner": o}, b)
+                    for o, b in sorted(mem["owners"].items())])
+            series("dstpu_device_memory_unattributed_bytes",
+                   "device bytes in use beyond every known owner — "
+                   "where leaks hide", "gauge",
+                   [("", {}, mem["unattributed_bytes"])])
         if hist is not None:
             # serving.tracing: the TTFT / TBT / queue-wait / dispatch /
             # lock-wait histograms (docs/observability.md)

@@ -1,0 +1,153 @@
+"""Live device-memory telemetry (``docs/observability.md``, "Device
+memory & roofline").
+
+The device side of the PR 13 observability layer: where spans and
+histograms explain *time*, this module explains *HBM* — the resource
+that actually produced the BENCH_r04 decode cliff (bs128's HBM
+utilization collapsing to 0.075 with no memory attribution on record).
+
+:class:`DeviceMemorySampler` is a host-side, default-off sampler that
+reads per-device ``bytes_in_use`` / ``peak_bytes_in_use`` /
+``bytes_limit`` through the accelerator's canonical
+``memory_snapshot()`` reader (the same number ``see_memory_usage``,
+the flops profiler and the autotuner report) and reconciles the
+serving engine's KNOWN owners — page pool, KV/draft workspaces,
+params — against the device total into an **unattributed bytes**
+figure: the gap is exactly where a leak, a retained donation copy or a
+forgotten staging buffer hides.
+
+Contracts (the PR 13 discipline):
+
+* **Host-side only.**  ``memory_stats()`` is a PJRT host call; no
+  jitted program is minted, sampling on/off leaves serving outputs
+  bitwise-identical (proven in ``tests/unit/test_memwatch.py``).
+* **Own cadence, cheap when idle.**  ``maybe_sample(now)`` is a clock
+  compare until ``interval_s`` elapses; the engine calls it at an
+  existing scheduler seam.
+* **Injectable reader.**  The tier-1 CPU backend reports no live
+  memory stats, so the reader is a constructor argument — tests (and
+  exotic platforms) inject their own; production uses the
+  accelerator.
+* **Flight-recorder integration.**  When a recorder is attached,
+  every sample also lands in the ring as a ``memory_sample`` event —
+  a crash dump then shows the HBM trajectory INTO the distress, not
+  just the scheduler's decisions.
+"""
+
+import time
+
+# The /metrics families the HTTP front end renders from a sampler
+# snapshot (``frontend/transport.py``) — a PURE literal: the
+# ``ds_lint --stats-docs`` gate parses this tuple (like
+# ``HISTOGRAM_SERIES`` in trace.py) and asserts every family is
+# documented in docs/observability.md.
+MEMORY_SERIES = (
+    "dstpu_device_memory_bytes_in_use",
+    "dstpu_device_memory_peak_bytes",
+    "dstpu_device_memory_limit_bytes",
+    "dstpu_device_memory_owned_bytes",
+    "dstpu_device_memory_unattributed_bytes",
+)
+
+
+def accelerator_reader():
+    """The production reader: the accelerator's canonical per-device
+    ``memory_snapshots()``."""
+    from deepspeed_tpu.accelerator.real_accelerator import get_accelerator
+    return get_accelerator().memory_snapshots()
+
+
+def tree_device_bytes(tree):
+    """Total device bytes of a pytree of arrays (``nbytes`` of every
+    leaf; 0 for leaves that carry none) — how owner figures are
+    computed without touching device data."""
+    import jax
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
+
+
+class DeviceMemorySampler:
+    """Periodic device-memory sampler with owner reconciliation.
+
+    ``owners_fn`` returns ``{owner_name: bytes}`` for every buffer
+    class the caller can account for; ``read_fn`` returns the
+    accelerator-normalized per-device snapshot list.  ``flightrec``
+    (optional) receives a ``memory_sample`` ring event per sample.
+    Not self-locked: the serving engine calls it lock-held at a
+    scheduler seam, matching the ``stats`` discipline."""
+
+    def __init__(self, interval_s=10.0, read_fn=None, owners_fn=None,
+                 flightrec=None, clock=time.monotonic):
+        self.interval_s = float(interval_s)
+        self._read = read_fn or accelerator_reader
+        self._owners = owners_fn or (lambda: {})
+        self._flightrec = flightrec
+        self._clock = clock
+        self._last_t = None
+        self.samples = 0
+        self.last = None                 # newest sample dict
+
+    def sample(self):
+        """Take one sample now: per-device snapshots + owner
+        reconciliation.  Returns the sample dict (also kept as
+        ``self.last``)."""
+        devices = list(self._read() or [])
+        owners = {k: int(v) for k, v in (self._owners() or {}).items()}
+        in_use = sum(d.get("bytes_in_use", 0) for d in devices)
+        peak = sum(d.get("peak_bytes_in_use", 0) for d in devices)
+        limit = sum(d.get("bytes_limit", 0) for d in devices)
+        owned = sum(owners.values())
+        # Unattributed = what the device holds beyond what the engine
+        # can name.  Clamped at zero: a backend that reports no live
+        # stats (the tier-1 CPU backend) yields in_use=0 and must not
+        # produce a negative gap.
+        unattributed = max(0, in_use - owned)
+        sample = {
+            "t_mono": round(self._clock(), 6),
+            "devices": devices,
+            "bytes_in_use": in_use,
+            "peak_bytes_in_use": peak,
+            "bytes_limit": limit,
+            "owners": owners,
+            "owned_bytes": owned,
+            "unattributed_bytes": unattributed,
+        }
+        self.samples += 1
+        self.last = sample
+        if self._flightrec is not None:
+            self._flightrec.record(
+                "memory_sample", bytes_in_use=in_use,
+                peak_bytes_in_use=peak, owned_bytes=owned,
+                unattributed_bytes=unattributed,
+                owners={k: v for k, v in sorted(owners.items())})
+        return sample
+
+    def maybe_sample(self, now=None):
+        """Sample when ``interval_s`` has elapsed since the last one
+        (a clock compare otherwise); returns the new sample or
+        ``None``."""
+        now = self._clock() if now is None else now
+        if self._last_t is not None \
+                and now - self._last_t < self.interval_s:
+            return None
+        self._last_t = now
+        return self.sample()
+
+def device_memory_record():
+    """One-shot normalized device-memory record for bench phases and
+    training runs (no sampler needed): per-device snapshots + the
+    summed in-use/peak/limit — the per-phase peak-HBM watermark."""
+    devices = accelerator_reader()
+    return {
+        "devices": devices,
+        "bytes_in_use": sum(d.get("bytes_in_use", 0) for d in devices),
+        "peak_bytes_in_use": sum(d.get("peak_bytes_in_use", 0)
+                                 for d in devices),
+        "bytes_limit": sum(d.get("bytes_limit", 0) for d in devices),
+    }
+
+
+__all__ = ["DeviceMemorySampler", "MEMORY_SERIES", "accelerator_reader",
+           "tree_device_bytes", "device_memory_record"]

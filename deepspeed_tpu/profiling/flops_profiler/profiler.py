@@ -68,38 +68,24 @@ def device_peak_hbm_gbps():
     return _device_peak(PEAK_HBM_GBPS, 819.0)
 
 
-# HBM capacity per chip in GB (public figures) — the fallback when the
-# backend reports no ``memory_stats()['bytes_limit']`` (tunneled/relay
-# PJRT platforms return empty stats).
-HBM_GB = {
-    "tpu v4": 32.0,
-    "tpu v5 lite": 16.0,    # v5e
-    "tpu v5e": 16.0,
-    "tpu v5": 96.0,         # v5p
-    "tpu v6 lite": 32.0,    # trillium
-    "cpu": 0.0,             # host RAM is not a fixed budget
-}
-
-
 def device_hbm_bytes():
-    """Device memory budget in bytes: the backend's reported bytes_limit
-    when available, else the datasheet capacity for the device kind (0.0
-    = unknown/unbounded, callers should skip budget checks)."""
-    d = jax.devices()[0]
-    stats = getattr(d, "memory_stats", lambda: None)() or {}
-    if stats.get("bytes_limit"):
-        return int(stats["bytes_limit"])
-    return int(_device_peak(HBM_GB, 0.0) * 1e9)
+    """Device memory budget in bytes, via the accelerator's canonical
+    ``memory_snapshot`` reader: the backend's reported ``bytes_limit``
+    when available, else the datasheet capacity for the device kind
+    (``accelerator.tpu_accelerator.DATASHEET_HBM_BYTES``; 0 =
+    unknown/unbounded, callers should skip budget checks)."""
+    from deepspeed_tpu.accelerator.real_accelerator import get_accelerator
+    return int(get_accelerator().memory_snapshot()["bytes_limit"])
 
 
 def cost_analysis_of(fn, *args, **kwargs):
-    """Compile ``fn`` and return XLA's cost analysis dict (flops, bytes)."""
-    lowered = jax.jit(fn).lower(*args, **kwargs)
-    compiled = lowered.compile()
-    costs = compiled.cost_analysis()
-    if isinstance(costs, list):
-        costs = costs[0] if costs else {}
-    return costs or {}
+    """Compile ``fn`` and return XLA's cost analysis dict (flops, bytes)
+    — the compiled-program extraction itself is the shared cost model
+    (``autotuning.cost_model.xla_cost_analysis``), the same code the
+    memory/FLOP contract layer and the bench roofline blocks read."""
+    from deepspeed_tpu.autotuning.cost_model import xla_cost_analysis
+    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    return xla_cost_analysis(compiled)
 
 
 class FlopsProfiler:

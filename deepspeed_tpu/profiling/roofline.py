@@ -1,0 +1,79 @@
+"""Roofline attribution: achieved FLOP/s and GB/s, arithmetic
+intensity, and a memory-bound/compute-bound classification for a
+measured program (``docs/observability.md``, "Device memory &
+roofline").
+
+The roofline model explains exactly the regression class the bench
+trail kept restating without attribution (BENCH_r04: decode collapsing
+8,673 → 1,193 tok/s/chip with HBM util at 0.075): a program whose
+arithmetic intensity (flops / HBM bytes) sits left of the machine's
+ridge point (peak FLOP/s ÷ peak GB/s) is **memory-bound** — its
+ceiling is the bandwidth roof, and an HBM-traffic regression cuts
+throughput linearly no matter how idle the MXU is.  Numerators come
+from the shared compiled cost model (``autotuning.cost_model``, the
+same numbers ``PROGRAMS.lock`` format 3 locks); denominators are the
+accelerator-reported peaks (the bench calibration phase's *measured*
+peaks when plausible, datasheet otherwise — the caller chooses and the
+block records which)."""
+
+
+def device_peaks(measured_tflops=None, measured_gbps=None):
+    """(peak_tflops, peak_gbps, source): the caller's measured peaks
+    when both are present, else the datasheet constants for the local
+    device kind."""
+    if measured_tflops and measured_gbps:
+        return float(measured_tflops), float(measured_gbps), "measured"
+    from deepspeed_tpu.profiling.flops_profiler.profiler import (
+        device_peak_hbm_gbps, device_peak_tflops)
+    return device_peak_tflops(), device_peak_hbm_gbps(), "datasheet"
+
+
+def classify(intensity, peak_tflops, peak_gbps):
+    """``"memory_bound"`` when ``intensity`` (flops/byte) sits left of
+    the ridge point ``peak_flops / peak_bandwidth``, else
+    ``"compute_bound"``; ``None`` when the inputs can't say."""
+    if not intensity or not peak_tflops or not peak_gbps:
+        return None
+    ridge = (peak_tflops * 1e12) / (peak_gbps * 1e9)
+    return "memory_bound" if intensity < ridge else "compute_bound"
+
+
+def roofline_block(flops, hbm_bytes, wall_s, peak_tflops=None,
+                   peak_gbps=None, peak_source=None):
+    """One roofline record for a program measured at ``wall_s`` seconds
+    per execution: ``{flops, hbm_bytes, wall_s, achieved_tflops,
+    achieved_gbps, intensity, ridge, bound, flops_fraction_of_peak,
+    hbm_fraction_of_peak, peak_source}``.  ``flops``/``hbm_bytes`` are
+    per-execution totals (the locked ``cost`` budget for contract
+    programs; an analytic estimate for model-level phases — the caller
+    owns the numerator's provenance)."""
+    flops = float(flops or 0)
+    hbm_bytes = float(hbm_bytes or 0)
+    wall_s = float(wall_s or 0)
+    block = {
+        "flops": int(flops),
+        "hbm_bytes": int(hbm_bytes),
+        "wall_s": round(wall_s, 6),
+        "intensity": round(flops / hbm_bytes, 3) if hbm_bytes else None,
+        "achieved_tflops": round(flops / wall_s / 1e12, 4)
+        if wall_s else None,
+        "achieved_gbps": round(hbm_bytes / wall_s / 1e9, 3)
+        if wall_s else None,
+    }
+    if peak_tflops and peak_gbps:
+        ridge = (peak_tflops * 1e12) / (peak_gbps * 1e9)
+        block["ridge"] = round(ridge, 3)
+        block["bound"] = classify(block["intensity"], peak_tflops,
+                                  peak_gbps)
+        if block["achieved_tflops"] is not None:
+            block["flops_fraction_of_peak"] = round(
+                block["achieved_tflops"] / peak_tflops, 4)
+        if block["achieved_gbps"] is not None:
+            block["hbm_fraction_of_peak"] = round(
+                block["achieved_gbps"] / peak_gbps, 4)
+        if peak_source:
+            block["peak_source"] = peak_source
+    return block
+
+
+__all__ = ["roofline_block", "classify", "device_peaks"]

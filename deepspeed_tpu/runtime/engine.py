@@ -919,7 +919,7 @@ class DeepSpeedEngine:
             if self._last_loss is not None:
                 events.append(("Train/Samples/train_loss",
                                float(jax.device_get(self._last_loss)), self.global_samples))  # tpu-lint: disable=TL001 -- monitor read, gated on steps_per_print
-            self.monitor.write_events(events)
+            self.monitor.write_events(events + self._hbm_events())
         if self.wall_clock_breakdown():
             self.timers(STEP_GLOBAL_TIMER).stop()
             if self.global_steps % self.steps_per_print() == 0:
@@ -1227,8 +1227,33 @@ class DeepSpeedEngine:
             self.monitor.write_events(
                 [("Train/Samples/lr", self.get_lr()[0], self.global_samples),
                  ("Train/Samples/train_loss", float(jax.device_get(loss)),  # tpu-lint: disable=TL001 -- monitor read, gated on steps_per_print
-                  self.global_samples)])
+                  self.global_samples)] + self._hbm_events())
         return loss
+
+    def _hbm_events(self):
+        """Peak-HBM watermark monitor events, print-gated like the loss
+        fetch (one PJRT ``memory_stats()`` host call per device through
+        the accelerator's canonical reader; empty on backends with no
+        live stats — the CPU test backend stays event-identical to the
+        pre-telemetry engine)."""
+        try:
+            wm = self.hbm_watermark()
+        except Exception:
+            return []
+        if not wm.get("peak_bytes_in_use"):
+            return []
+        return [("Train/Samples/hbm_bytes_in_use",
+                 wm["bytes_in_use"], self.global_samples),
+                ("Train/Samples/hbm_peak_bytes",
+                 wm["peak_bytes_in_use"], self.global_samples)]
+
+    def hbm_watermark(self):
+        """Per-run peak-HBM watermark: the accelerator's canonical
+        per-device memory record (process-lifetime peak — one training
+        run owns its process in every bench phase), for callers stamping
+        records (``bench.py`` train phases read this at run end)."""
+        from deepspeed_tpu.monitor.memwatch import device_memory_record
+        return device_memory_record()
 
     def eval_batch(self, batch):
         prev = self.training

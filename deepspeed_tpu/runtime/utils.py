@@ -73,19 +73,25 @@ def memory_status(msg=""):
 
 
 def see_memory_usage(message, force=False):
-    """Device + host memory dump (reference ``see_memory_usage``)."""
+    """Device + host memory dump (reference ``see_memory_usage``) —
+    device numbers come from the accelerator's canonical
+    ``memory_snapshot`` reader, so "HBM in use" here is the same number
+    the profiler budget, the autotuner and the serving memory sampler
+    report."""
     if not force and os.environ.get("DSTPU_MEMORY_DEBUG", "0") != "1":
         return
+    from deepspeed_tpu.accelerator.real_accelerator import get_accelerator
     lines = [message]
-    for d in jax.local_devices():
-        try:
-            stats = d.memory_stats() or {}
-            used = stats.get("bytes_in_use", 0)
-            limit = stats.get("bytes_limit", 0)
-            lines.append(f"  {d}: {used / 2**30:.2f}GB used"
-                         + (f" / {limit / 2**30:.2f}GB" if limit else ""))
-        except Exception:
-            lines.append(f"  {d}: memory stats unavailable")
+    try:
+        snaps = get_accelerator().memory_snapshots()
+    except Exception:
+        snaps = []
+        lines.append("  device memory stats unavailable")
+    for s in snaps:
+        used, limit = s["bytes_in_use"], s["bytes_limit"]
+        lines.append(f"  {s['device']}: {used / 2**30:.2f}GB used"
+                     + (f" / {limit / 2**30:.2f}GB "
+                        f"({s['limit_source']})" if limit else ""))
     try:
         import resource
         rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**20

@@ -44,6 +44,16 @@ def main(argv=None):
                              "sizes 1/2/4/8, diff bytes-per-chip against "
                              "PROGRAMS.lock, fail on undeclared per-chip "
                              "growth)")
+    parser.add_argument("--mem", action="store_true",
+                        help="run the memory-contract gate: recompile "
+                             "the hot-path programs (positional args "
+                             "limit to those program names) and the "
+                             "sharding plans in the forced tier-1 env, "
+                             "extract compiled.memory_analysis() + "
+                             "cost_analysis() budgets, and diff them "
+                             "against PROGRAMS.lock format 3 — exit 1 "
+                             "on any beyond-tolerance byte drift or "
+                             "undeclared memory growth")
     parser.add_argument("--update", action="store_true",
                         help="with --contracts: rewrite PROGRAMS.lock "
                              "from the freshly extracted contracts")
@@ -75,6 +85,12 @@ def main(argv=None):
         from deepspeed_tpu.tools.lint import comm_contract, contract
         contract.ensure_harness_env()
         return comm_contract.main(args.paths or None)
+    if args.mem:
+        # tier-1 env forced: memory budgets are locked under the same
+        # backend the CLI must re-extract them on
+        from deepspeed_tpu.tools.lint import contract, mem_contract
+        contract.ensure_harness_env()
+        return mem_contract.main(args.paths or None)
     if args.concurrency:
         # the tier-1 env is forced like --contracts/--jaxpr so the CLI
         # and the CI gate agree on what they check

@@ -23,7 +23,17 @@ a committed artifact:
   at mesh sizes {1, 2, 4, 8}: "all-gather bytes: 2.1MB -> 67MB" is a
   reviewable regression where a bare count change is not, and a per-chip
   volume that GROWS with mesh size is the replicated-tensor smell the
-  ``ds_lint --comm`` prover fails on.
+  ``ds_lint --comm`` prover fails on;
+* **memory/FLOP budgets** (:mod:`.mem_contract`, format 3) —
+  ``compiled.memory_analysis()`` byte footprints (argument / output /
+  temp / alias / live total) and ``cost_analysis()`` flops +
+  bytes-accessed for every program and plan: "decode_step temp HBM:
+  96MB -> 612MB" fails at lock-diff time instead of surfacing as an OOM
+  or an HBM-utilization cliff rounds later, and ``--update`` refuses
+  undeclared growth (the ``ds_lint --mem`` gate).  Memory needs a
+  compile, so the FAST gate diffs program contracts without it (plans
+  carry memory for free on their schedule compile); the per-program
+  memory regen is the ``slow``-marked half of the contract tests.
 
 ``PROGRAMS.lock`` (repo root, committed) is regenerated-and-diffed by a
 tier-1 gate and by ``ds_lint --contracts`` (``--update`` rewrites it); a
@@ -115,26 +125,40 @@ def _multiset_hash(counts):
     return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
-def contract_of_entry_point(ep):
+def contract_of_entry_point(ep, with_memory=False):
     """Machine-checkable contract of one :class:`entry_points.EntryPoint`:
     traced primitive multiset + hash, host-callback count, jaxpr-level
     collective counts, lowered donation-alias count, the abstract
     input/output signatures, and the byte-level comm budget (``{}`` for a
     program whose lowering mentions no collective — the single-chip hot
     paths answer without paying for a compile; a mesh-aware program is
-    compiled and its optimized HLO costed)."""
+    compiled and its optimized HLO costed).
+
+    ``with_memory=True`` additionally compiles the program and locks its
+    memory/FLOP budget (:mod:`.mem_contract`) — the expensive half, paid
+    by ``--update``/``--mem``/the slow contract test, never by the fast
+    tier-1 per-program diff (whose diff skips the memory sections when
+    the fresh side omits them)."""
     import jax
-    from deepspeed_tpu.tools.lint import comm_contract
+    from deepspeed_tpu.tools.lint import comm_contract, mem_contract
     from deepspeed_tpu.tools.lint.jaxpr_check import FORBIDDEN_PRIMITIVES
     counts, closed = primitive_counts_of(ep.fn, *ep.args)
     lowered = ep.fn.lower(*ep.args)
     text = lowered.as_text()
     aliased = sum(text.count(a) for a in _ALIAS_ATTRS)
     comm = {}
+    compiled = None
+    if with_memory:
+        # memory analysis is only trustworthy on a REAL compile — a
+        # persistent-cache reload reports degenerate alias bytes
+        with mem_contract.fresh_compile_env():
+            compiled = lowered.compile()
+    elif comm_contract.lowered_has_collectives(text):
+        compiled = lowered.compile()
     if comm_contract.lowered_has_collectives(text):
-        hlo = lowered.compile().as_text() or ""
+        hlo = compiled.as_text() or ""
         comm = comm_contract.parse_hlo_comm(hlo, jax.device_count())
-    return {
+    c = {
         "kind": "program",
         "primitives": dict(sorted(counts.items())),
         "primitives_sha256": _multiset_hash(counts),
@@ -149,6 +173,9 @@ def contract_of_entry_point(ep):
         "in_avals": [str(a) for a in closed.in_avals],
         "out_avals": [str(a) for a in closed.out_avals],
     }
+    if with_memory:
+        mem_contract.attach_memory_contract(c, ep.name, compiled)
+    return c
 
 
 def contract_of_plan(plan):
@@ -156,16 +183,21 @@ def contract_of_plan(plan):
     :class:`parallel.plans.PlanProgram`: the counts AND byte volumes of
     every collective op in the OPTIMIZED HLO the plan's fused train step
     compiles to on the 8-device mesh (what the MULTICHIP dry-run measures
-    at runtime).  The one compile feeds both the count schedule and the
-    comm budget."""
-    from deepspeed_tpu.tools.lint import comm_contract
-    text = plan.fn.lower(*plan.args).compile().as_text() or ""
+    at runtime).  The one compile feeds the count schedule, the comm
+    budget AND the memory/FLOP budget — plans pay no extra compile for
+    their memory contract.  The compile runs cache-bypassed
+    (``fresh_compile_env``): a persistent-cache reload would report the
+    plan's donated-alias bytes as 0 and corrupt the locked footprint."""
+    from deepspeed_tpu.tools.lint import comm_contract, mem_contract
+    with mem_contract.fresh_compile_env():
+        compiled = plan.fn.lower(*plan.args).compile()
+    text = compiled.as_text() or ""
     counts = {}
     for op in HLO_COLLECTIVES:
         n = len(re.findall(rf"\b{op}(?:-start)?\(", text))
         if n:
             counts[op] = n
-    return {
+    c = {
         "kind": "collective_schedule",
         "mesh": {k: int(v) for k, v in sorted(plan.mesh.items())},
         "world": int(plan.world),
@@ -174,6 +206,7 @@ def contract_of_plan(plan):
         "expect": sorted(plan.expect),
         "reduction": bool(plan.reduction),
     }
+    return mem_contract.attach_memory_contract(c, plan.name, compiled)
 
 
 def validate_plan_contract(contract):
@@ -207,15 +240,17 @@ def program_names():
     return [b.__name__ for b in entry_points.BUILDERS]
 
 
-def build_program_contract(builder_name):
+def build_program_contract(builder_name, with_memory=False):
     """Contract for one entry point, with the global topology reset around
-    the engine build (same discipline as the jaxpr-harness tests)."""
+    the engine build (same discipline as the jaxpr-harness tests).
+    ``with_memory`` opts into the compile the memory/FLOP budget costs."""
     from deepspeed_tpu.parallel.topology import reset_topology
     from deepspeed_tpu.tools.lint import entry_points
     reset_topology()
     try:
         ep = getattr(entry_points, builder_name)()
-        return ep.name, contract_of_entry_point(ep)
+        return ep.name, contract_of_entry_point(ep,
+                                                with_memory=with_memory)
     finally:
         reset_topology()
 
@@ -249,16 +284,20 @@ def build_plan_scaling_contract(plan_builder_name, full_contract=None):
                                                 reuse_rows=reuse_rows)
 
 
-def build_all(progress=None):
-    """Regenerate every contract.  Returns the lockfile dict."""
+def build_all(progress=None, with_memory=True):
+    """Regenerate every contract.  Returns the lockfile dict.
+    ``with_memory=True`` (the default — ``--update`` and the CLI gates
+    want the full format-3 artifact) compiles every program for its
+    memory/FLOP budget; the fast tier-1 tests never call this."""
     import jax
     import jaxlib
     from deepspeed_tpu.parallel import plans
     programs, schedules, scaling = {}, {}, {}
     for bname in program_names():
         if progress:
-            progress(f"tracing {bname}")
-        name, c = build_program_contract(bname)
+            progress(f"tracing {bname}"
+                     + (" (+memory compile)" if with_memory else ""))
+        name, c = build_program_contract(bname, with_memory=with_memory)
         programs[name] = c
     for build in plans.PLAN_BUILDERS:
         if progress:
@@ -273,7 +312,7 @@ def build_all(progress=None):
         scaling[sname or name] = sc
     return {
         "_meta": {
-            "format": 2,
+            "format": 3,
             "harness": "JAX_PLATFORMS=cpu, 8 virtual devices (tier-1)",
             "jax": jax.__version__,
             "jaxlib": jaxlib.__version__,
@@ -329,6 +368,18 @@ def _diff_comm(locked, fresh, out):
                        f"{fr.get('count', 0)}")
 
 
+def _diff_mem(locked, fresh, out):
+    """Memory/FLOP budget diff (tolerance-banded byte stories) — only
+    when the FRESH side carries the sections: the fast tier-1 gate
+    regenerates contracts without the memory compile and must not read
+    a locked budget as a break (``ds_lint --mem`` and the slow contract
+    test regenerate WITH memory and do diff it)."""
+    from deepspeed_tpu.tools.lint import mem_contract
+    if "memory" not in fresh and "cost" not in fresh:
+        return
+    out.extend(mem_contract.diff_memory("", locked, fresh))
+
+
 def _schedule_summary(contract):
     """One-line schedule rendering (counts + bytes when budgeted) for the
     side-by-side view of a changed schedule."""
@@ -355,6 +406,7 @@ def diff_program(name, locked, fresh):
                                fresh.get("collectives", {}), out)
         _diff_comm(locked.get("comm", {}) or {},
                    fresh.get("comm", {}) or {}, out)
+        _diff_mem(locked, fresh, out)
         for field in ("mesh", "expect", "reduction", "world"):
             if locked.get(field) != fresh.get(field):
                 out.append(f"  {field}: {locked.get(field)} -> "
@@ -379,6 +431,7 @@ def diff_program(name, locked, fresh):
                  fresh.get("collectives", {}), out)
     _diff_comm(locked.get("comm", {}) or {}, fresh.get("comm", {}) or {},
                out)
+    _diff_mem(locked, fresh, out)
     ld, fd = locked.get("donation", {}), fresh.get("donation", {})
     if ld != fd:
         out.append(f"  donation: declared={ld.get('declared')} "
@@ -434,10 +487,14 @@ def check_against_lockfile(path=None, progress=None):
     for name, c in sorted(fresh.get("collective_schedules", {}).items()):
         for problem in validate_plan_contract(c):
             diff.append(f"{name}: plan invariant broken — {problem}")
+    from deepspeed_tpu.tools.lint import mem_contract
     from deepspeed_tpu.tools.lint.comm_contract import \
         validate_scaling_contract
     for name, c in sorted(fresh.get("mesh_scaling", {}).items()):
         diff.extend(validate_scaling_contract(name, c))
+    for section in ("programs", "collective_schedules"):
+        for name, c in sorted(fresh.get(section, {}).items()):
+            diff.extend(mem_contract.validate_memory_contract(name, c))
     return not diff, diff
 
 
@@ -446,6 +503,27 @@ def main(update=False):
     progress = lambda msg: print(f"[contracts] {msg}", flush=True)
     if update:
         lock = build_all(progress=progress)
+        # memory-growth ratchet: an --update that would lock a byte
+        # footprint grown beyond tolerance over the COMMITTED artifact
+        # is refused unless the program declares the growth with a
+        # reason (mem_contract.DECLARED_GROWTH) — memory bloat cannot
+        # land through a routine lockfile bump
+        from deepspeed_tpu.tools.lint import mem_contract
+        try:
+            old = load_lockfile()
+        except FileNotFoundError:
+            old = {}
+        problems = []
+        for section in ("programs", "collective_schedules"):
+            for name, fresh_c in sorted(lock.get(section, {}).items()):
+                problems.extend(mem_contract.growth_problems(
+                    name, old.get(section, {}).get(name), fresh_c))
+        if problems:
+            print(f"[contracts] UPDATE REFUSED — memory growth beyond "
+                  f"the {mem_contract.MEM_TOLERANCE:.0%} tolerance:")
+            for p in problems:
+                print(f"  {p}")
+            return 1
         path = write_lockfile(lock)
         n = len(lock["programs"]) + len(lock["collective_schedules"])
         print(f"[contracts] wrote {n} contracts to {path}")

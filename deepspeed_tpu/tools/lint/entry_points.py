@@ -409,6 +409,32 @@ BUILDERS = (runtime_train_step, runtime_apply_update, inference_decode,
             serving_spec_draft_prefill, serving_spec_draft_admit,
             hybrid_rollout)
 
+# builder function name -> the EntryPoint name it constructs.  Lets
+# name-filtered sweeps (``ds_lint --mem <program>``, the bench
+# memory_snapshot subset) skip the engine builds of filtered-out
+# programs instead of paying all 16 just to learn their names.  Kept
+# honest mechanically: every consumer cross-checks ``ep.name`` against
+# this map after building, so drift fails loudly instead of silently
+# skipping the wrong program.
+BUILDER_PROGRAMS = {
+    "runtime_train_step": "runtime.train_step",
+    "runtime_apply_update": "runtime.apply_update",
+    "inference_decode": "inference.decode",
+    "inference_prefill_chunk": "inference.prefill_chunk",
+    "serving_decode_step": "serving.decode_step",
+    "serving_admission_prefill": "serving.admission_prefill",
+    "serving_admit": "serving.admit",
+    "serving_decode_step_paged": "serving.decode_step_paged",
+    "serving_admission_prefill_paged": "serving.prefill_chunk_paged",
+    "serving_admit_paged": "serving.admit_paged",
+    "serving_spec_propose": "serving.spec_propose",
+    "serving_spec_verify": "serving.spec_verify",
+    "serving_spec_verify_paged": "serving.spec_verify_paged",
+    "serving_spec_draft_prefill": "serving.spec_draft_prefill",
+    "serving_spec_draft_admit": "serving.spec_draft_admit",
+    "hybrid_rollout": "hybrid.rollout",
+}
+
 
 def iter_entry_points():
     for build in BUILDERS:

@@ -10,9 +10,10 @@ discipline every other gate here follows) collects:
   ``stats.get("key")`` accesses), and
 * every ``/metrics`` series name the HTTP front end exports
   (``frontend/transport.py``: ``gauge("name", ...)`` first arguments
-  prefixed ``dstpu_serving_``, plus full ``dstpu_*`` string literals)
-  and the histogram families ``monitor/trace.py`` declares in its
-  ``HISTOGRAM_SERIES`` literal,
+  prefixed ``dstpu_serving_``, plus full ``dstpu_*`` string literals),
+  the histogram families ``monitor/trace.py`` declares in its
+  ``HISTOGRAM_SERIES`` literal, and the device-memory families
+  ``monitor/memwatch.py`` declares in its ``MEMORY_SERIES`` literal,
 
 then asserts each appears as a backticked token in the observability
 doc's tables.  Exit 1 lists what is missing; wired into tier-1 via
@@ -31,6 +32,7 @@ ENGINE_PY = os.path.join(_PKG, "inference", "serving", "engine.py")
 TRANSPORT_PY = os.path.join(_PKG, "inference", "serving", "frontend",
                             "transport.py")
 TRACE_PY = os.path.join(_PKG, "monitor", "trace.py")
+MEMWATCH_PY = os.path.join(_PKG, "monitor", "memwatch.py")
 DOC_MD = os.path.join(os.path.dirname(_PKG), "docs", "observability.md")
 
 
@@ -79,11 +81,13 @@ def collect_stats_keys(engine_path=ENGINE_PY):
 
 
 def collect_metric_series(transport_path=TRANSPORT_PY,
-                          trace_path=TRACE_PY):
+                          trace_path=TRACE_PY,
+                          memwatch_path=MEMWATCH_PY):
     """Every ``/metrics`` series name: ``gauge("x", ...)`` calls (the
     ``dstpu_serving_`` prefix is applied by the helper), whole
-    ``dstpu_*`` string literals, and the ``HISTOGRAM_SERIES`` tuple the
-    trace module declares as a pure literal."""
+    ``dstpu_*`` string literals, and the ``HISTOGRAM_SERIES`` /
+    ``MEMORY_SERIES`` tuples the trace and memwatch modules declare as
+    pure literals."""
     series = set()
     for node in ast.walk(_parse(transport_path)):
         if isinstance(node, ast.Call):
@@ -101,12 +105,13 @@ def collect_metric_series(transport_path=TRANSPORT_PY,
             if v.startswith("dstpu_") and not v.endswith("_") \
                     and re.fullmatch(r"[a-z0-9_]+", v):
                 series.add(v)
-    for node in _parse(trace_path).body:
-        if isinstance(node, ast.Assign) \
-                and any(isinstance(t, ast.Name)
-                        and t.id == "HISTOGRAM_SERIES"
-                        for t in node.targets):
-            series.update(ast.literal_eval(node.value))
+    for path, literal in ((trace_path, "HISTOGRAM_SERIES"),
+                          (memwatch_path, "MEMORY_SERIES")):
+        for node in _parse(path).body:
+            if isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name) and t.id == literal
+                            for t in node.targets):
+                series.update(ast.literal_eval(node.value))
     return series
 
 

@@ -187,10 +187,15 @@ def test_bench_round_robin_phase_order(tmp_path, monkeypatch):
     (tmp_path / "BENCH_r02.json").write_text("{half a reco")
     order = [k for k, _, _ in bench._phase_order(bench.PHASES)]
     assert order[0] == "calibration"
+    # the memory micro-phase is pinned right behind calibration: the
+    # per-program memory record commits before any heavy phase can
+    # starve it (the r05-blackout lesson on the memory axis)
+    assert order[1] == "memory_snapshot"
     assert sorted(order) == sorted(base)    # nothing dropped or invented
     measured = {"sft_350m_guard", "__headline__"}
+    pinned = {"calibration", "memory_snapshot"}
     starved = [k for k in base
-               if k not in measured and k != "calibration"]
+               if k not in measured and k not in pinned]
     # every starved phase (incl. the skipped + timed-out ones) runs
     # before anything measured in round 1...
     assert max(order.index(k) for k in starved) \

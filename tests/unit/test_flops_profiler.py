@@ -91,3 +91,93 @@ def test_module_profile_walk_depths():
     root.child("a").child("b")
     depths = {node.name: d for d, node in root.walk()}
     assert depths == {"": 0, "a": 1, "b": 2}
+
+
+# --------------------------------------------------------------------- #
+# The unified device-memory reader + shared cost model (PR: device-memory
+# & roofline observatory)
+# --------------------------------------------------------------------- #
+class _FakeAccel:
+    """Accelerator stub with a controllable memory_snapshot."""
+
+    def __init__(self, limit, source):
+        self._limit, self._source = limit, source
+
+    def memory_snapshot(self, device_index=None):
+        return {"device": "fake:0", "platform": "fake",
+                "bytes_in_use": 123, "peak_bytes_in_use": 456,
+                "bytes_limit": self._limit, "limit_source": self._source}
+
+
+def test_device_hbm_bytes_prefers_backend_limit(monkeypatch):
+    from deepspeed_tpu.accelerator import real_accelerator
+    from deepspeed_tpu.profiling.flops_profiler import profiler
+    monkeypatch.setattr(real_accelerator, "_accelerator",
+                        _FakeAccel(7 * 2**30, "runtime"))
+    assert profiler.device_hbm_bytes() == 7 * 2**30
+
+
+def test_device_hbm_bytes_missing_limit_falls_back(monkeypatch):
+    """The previously untested bytes_limit-missing path: a backend
+    reporting no limit answers through the accelerator's datasheet
+    fallback; fully unknown answers 0 and callers must skip budget
+    checks."""
+    from deepspeed_tpu.accelerator import real_accelerator
+    from deepspeed_tpu.profiling.flops_profiler import profiler
+    monkeypatch.setattr(real_accelerator, "_accelerator",
+                        _FakeAccel(0, "unknown"))
+    assert profiler.device_hbm_bytes() == 0
+    # the datasheet path itself: a TPU-kind device with empty live stats
+    from deepspeed_tpu.accelerator.tpu_accelerator import \
+        datasheet_hbm_bytes
+
+    class _Dev:
+        device_kind = "TPU v5 lite"
+        platform = "tpu"
+    assert datasheet_hbm_bytes(_Dev()) == int(16.0e9)
+
+    class _Unknown:
+        device_kind = "mystery"
+        platform = "mystery"
+    assert datasheet_hbm_bytes(_Unknown()) == 0
+
+
+def test_memory_snapshot_datasheet_source(monkeypatch):
+    """TPU_Accelerator.memory_snapshot: live bytes_limit wins; absent
+    live stats fall back to the datasheet capacity with the source
+    labeled — the one reader every consumer shares."""
+    from deepspeed_tpu.accelerator.tpu_accelerator import TPU_Accelerator
+
+    class _Dev:
+        id = 0
+        device_kind = "TPU v4"
+        platform = "tpu"
+
+        def memory_stats(self):
+            return {}                    # tunneled PJRT: empty stats
+    accel = TPU_Accelerator()
+    monkeypatch.setattr(accel, "devices", lambda: [_Dev()])
+    snap = accel.memory_snapshot()
+    assert snap["bytes_limit"] == int(32.0e9)
+    assert snap["limit_source"] == "datasheet"
+    assert snap["bytes_in_use"] == 0
+
+
+def test_cost_analysis_of_routes_through_shared_model():
+    """profile-side cost extraction == the contract/roofline cost model
+    (autotuning.cost_model.xla_cost_analysis) on the same program."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.autotuning.cost_model import (compiled_costs,
+                                                     xla_cost_analysis)
+    from deepspeed_tpu.profiling.flops_profiler.profiler import \
+        cost_analysis_of
+
+    def f(x):
+        return (x @ x).sum()
+    x = jnp.ones((32, 32))
+    via_profiler = cost_analysis_of(f, x)
+    compiled = jax.jit(f).lower(x).compile()
+    assert via_profiler == xla_cost_analysis(compiled)
+    costs = compiled_costs(compiled)
+    assert costs["flops"] == float(via_profiler.get("flops", 0.0)) > 0
+    assert costs["bytes_accessed"] > 0

@@ -16,12 +16,15 @@ points (~2 min) and runs as the ``slow``-marked test at the bottom and as
 absorb the compiles."""
 
 import json
+import os
 import re
 import pathlib
+import subprocess
+import sys
 
 import pytest
 
-from deepspeed_tpu.tools.lint import comm_contract, contract
+from deepspeed_tpu.tools.lint import comm_contract, contract, mem_contract
 
 HERE = pathlib.Path(__file__).resolve().parent
 REPO = HERE.parents[1]
@@ -382,6 +385,199 @@ def test_hlo_comm_parser_formats():
     assert comm["all-to-all"] == {"count": 1, "bytes_per_step": 512}
     assert comm["collective-permute"] == {"count": 1,
                                           "bytes_per_step": 1024}
+
+
+# ------------------------------------------------------------------ #
+# Memory/FLOP contracts (PROGRAMS.lock format 3, tools/lint/
+# mem_contract.py) — artifact invariants + the synthetic-break proof
+# run fast (no hot-path compiles); the per-program regen-and-diff is
+# slow-marked (16 compiles) like the mesh-scaling sweep
+# ------------------------------------------------------------------ #
+def test_lockfile_format3_carries_memory_and_cost(lock):
+    """Every locked program AND plan carries a memory_analysis byte
+    footprint and a cost_analysis budget, internally consistent (the
+    no-compile half of the acceptance bar)."""
+    assert lock["_meta"]["format"] >= 3
+    for section in ("programs", "collective_schedules"):
+        for name, c in lock[section].items():
+            mem, cost = c.get("memory"), c.get("cost")
+            assert mem and cost, f"{name}: no memory/cost contract"
+            for field in mem_contract.MEM_FIELDS + ("total_bytes",):
+                assert isinstance(mem.get(field), int), (name, field)
+            assert mem["total_bytes"] == (
+                mem["argument_size_in_bytes"]
+                + mem["output_size_in_bytes"]
+                + mem["temp_size_in_bytes"]
+                - mem["alias_size_in_bytes"]), name
+            assert cost["flops"] > 0, name
+            assert cost["bytes_accessed"] > 0, name
+            assert not mem_contract.validate_memory_contract(name, c), \
+                mem_contract.validate_memory_contract(name, c)
+    # donated programs buy real bytes: every program whose donation
+    # aliases buffers aliases >0 bytes in the memory contract
+    for name, c in lock["programs"].items():
+        if c["donation"]["declared"] and c["donation"]["aliased"]:
+            assert c["memory"]["alias_size_in_bytes"] > 0, name
+
+
+def test_memory_diff_tolerance_band():
+    """Within-tolerance drift is silent (compiler noise across patch
+    releases must not flip the gate); beyond it, the byte story
+    renders."""
+    base = {"memory": {"argument_size_in_bytes": 1 << 20,
+                       "output_size_in_bytes": 1 << 20,
+                       "temp_size_in_bytes": 100 * 1024,
+                       "alias_size_in_bytes": 1 << 20,
+                       "generated_code_size_in_bytes": 0,
+                       "total_bytes": (1 << 20) + 100 * 1024},
+            "cost": {"flops": 10 ** 9, "bytes_accessed": 10 ** 8}}
+    within = json.loads(json.dumps(base))
+    within["memory"]["temp_size_in_bytes"] += 1024      # ~1% < 2%
+    assert mem_contract.diff_memory("p", base, within) == []
+    beyond = json.loads(json.dumps(base))
+    beyond["memory"]["temp_size_in_bytes"] = 612 * 1024
+    lines = mem_contract.diff_memory("p", base, beyond)
+    text = "\n".join(lines)
+    assert "temp HBM: 100.0KB -> 612.0KB" in text
+    assert "MEMORY GROWTH beyond tolerance" in text
+    # cost drift diffs too
+    slower = json.loads(json.dumps(base))
+    slower["cost"]["flops"] = 2 * 10 ** 9
+    assert any("flops" in ln for ln in
+               mem_contract.diff_memory("p", base, slower))
+
+
+def _synthetic_mem_ep(donate=True):
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.tools.lint.entry_points import EntryPoint
+
+    def update(params, cache):
+        return jax.tree.map(lambda c: c + 1.0, cache)
+
+    fn = jax.jit(update, donate_argnums=(1,)) if donate else jax.jit(update)
+    args = ({"w": jnp.ones((4, 4))}, {"k": jnp.zeros((128, 1024))})
+    return EntryPoint("synthetic.update", fn, args, expect_donation=donate)
+
+
+def test_dropped_donation_memory_break_fails_readably():
+    """The acceptance synthetic break: dropping a donation makes the
+    aliased bytes vanish and the live total jump by the whole donated
+    buffer — the diff renders the byte story, and the update-time
+    growth ratchet REFUSES the regression unless declared."""
+    locked = contract.contract_of_entry_point(_synthetic_mem_ep(True),
+                                              with_memory=True)
+    fresh = contract.contract_of_entry_point(_synthetic_mem_ep(False),
+                                             with_memory=True)
+    assert locked["memory"]["alias_size_in_bytes"] > 0
+    assert fresh["memory"]["alias_size_in_bytes"] == 0
+    assert fresh["memory"]["total_bytes"] \
+        > locked["memory"]["total_bytes"]
+    diff = contract.diff_program("synthetic.update", locked, fresh)
+    text = "\n".join(diff)
+    assert diff and diff[0] == "synthetic.update:"
+    assert "donated-alias HBM" in text and "live HBM total" in text
+    assert "MEMORY GROWTH beyond tolerance" in text
+    problems = mem_contract.growth_problems("synthetic.update", locked,
+                                            fresh)
+    assert problems and "GROWS" in problems[0] \
+        and "cannot land silently" in problems[0]
+    # a declared reason clears the ratchet (but never the lock diff)
+    assert not mem_contract.growth_problems(
+        "synthetic.update", locked, fresh,
+        declared={"synthetic.update": "intentional double-buffer"})
+    # shrinkage diffs (regen to claim the win) but never trips growth
+    assert not mem_contract.growth_problems("synthetic.update", fresh,
+                                            locked)
+    # the FAST gate regenerates without memory: the same locked
+    # contract diffs clean against a fresh side with no memory section
+    no_mem = contract.contract_of_entry_point(_synthetic_mem_ep(True))
+    assert "memory" not in no_mem
+    assert contract.diff_program("synthetic.update", locked, no_mem) \
+        == []
+
+
+def test_mem_gate_unknown_name_fails_not_green():
+    """A misspelled program name must NEVER exit 0 having checked
+    nothing — the filtered sweep reports unknown names as a failure
+    (and, thanks to the static builder->program map, without paying a
+    single engine build, which is what keeps this test fast)."""
+    ok, lines = mem_contract.check_memory_against_lockfile(
+        names={"serving.decode_stpe"})
+    assert not ok
+    text = "\n".join(lines)
+    assert "unknown program name" in text
+    assert "serving.decode_stpe" in text
+    assert "serving.decode_step" in text          # the known list helps
+
+
+def test_builder_program_map_is_complete():
+    """Every registered builder appears in the static map (the
+    cross-check against what each builder actually constructs runs in
+    the slow regen test and in every --mem sweep)."""
+    from deepspeed_tpu.tools.lint import entry_points
+    assert set(entry_points.BUILDER_PROGRAMS) \
+        == {b.__name__ for b in entry_points.BUILDERS}
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("builder_name", contract.program_names())
+def test_program_memory_contract_matches_lockfile(lock, builder_name):
+    """The full memory regen-and-diff of one program: compile it and
+    hold its byte footprint + cost budget against the committed lock
+    within tolerance.  ``slow``: one compile per program (the PR 14
+    budget discipline — tier-1's wall clock cannot absorb 16 compiles);
+    run via ``ds_lint --mem`` or ``-m slow``."""
+    name, fresh = contract.build_program_contract(builder_name,
+                                                  with_memory=True)
+    from deepspeed_tpu.tools.lint import entry_points
+    assert entry_points.BUILDER_PROGRAMS[builder_name] == name, \
+        "builder->program map drifted — name-filtered --mem sweeps " \
+        "would skip the wrong program"
+    locked = lock["programs"].get(name)
+    assert locked is not None, name
+    diff = mem_contract.diff_memory(name, locked, fresh)
+    assert not diff, f"memory-contract break for {name}:\n" + \
+        "\n".join(diff)
+    assert not mem_contract.growth_problems(name, locked, fresh)
+
+
+@pytest.mark.slow
+def test_ds_lint_mem_cli_exits_1_on_memory_break(tmp_path):
+    """Acceptance: ``ds_lint --mem`` exits 1 from the CLI on a memory
+    break, with the byte story on stdout.  A tampered lockfile (the
+    locked temp bytes shrunk 8x, so the real program reads as an 8x
+    regression) drives the real subprocess gate on one program."""
+    tampered = json.loads(LOCK.read_text())
+    m = tampered["programs"]["serving.decode_step"]["memory"]
+    m["temp_size_in_bytes"] //= 8
+    m["total_bytes"] = (m["argument_size_in_bytes"]
+                        + m["output_size_in_bytes"]
+                        + m["temp_size_in_bytes"]
+                        - m["alias_size_in_bytes"])
+    bad = tmp_path / "PROGRAMS.tampered.lock"
+    bad.write_text(json.dumps(tampered))
+    env = dict(os.environ, DSTPU_MEM_LOCKFILE=str(bad),
+               JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.tools.lint", "--mem",
+         "serving.decode_step"],
+        capture_output=True, text=True, env=env, cwd=str(REPO),
+        timeout=900)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "MEMORY-CONTRACT BREAK" in proc.stdout
+    assert "temp HBM" in proc.stdout
+    assert "GROWS" in proc.stdout
+    # and the untampered lock answers 0 for the same program
+    env.pop("DSTPU_MEM_LOCKFILE")
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.tools.lint", "--mem",
+         "serving.decode_step"],
+        capture_output=True, text=True, env=env, cwd=str(REPO),
+        timeout=900)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
 
 
 @pytest.mark.slow

@@ -978,6 +978,19 @@ def serving_paged_bench(model_name="opt-1.3b", *, slots_list=(96, 128, 192),
     eng.init_params()
     rng = np.random.default_rng(0)
     n_dev = jax.device_count()
+    # roofline numerators (constant across concurrency levels): int8
+    # weights stream once per decode step; KV bytes come from the live
+    # page-pool occupancy sampled at the decode window (the paged kernel
+    # pins dead-tail page indices to the last live page, so repeated-index
+    # DMAs are elided and only live pages cost traffic)
+    param_bytes = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                      for l in jax.tree.leaves(eng.params))
+    param_count = sum(int(np.prod(l.shape))
+                      for l in jax.tree.leaves(eng.params))
+    # bytes per cached position, k + v: int8 payload + f32 per-head scale
+    kv_row = 2 * (cfg.kv_heads * cfg.head_dim + cfg.kv_heads * 4)
+    plan_mode, plan_chunk, plan_why = eng.prefill_plan(
+        max(slots_list), 256, paged=True)
     per_bs = {}
     for bs in slots_list:
         n_requests = 2 * bs                 # slots churn at least once
@@ -989,6 +1002,7 @@ def serving_paged_bench(model_name="opt-1.3b", *, slots_list=(96, 128, 192),
         num_pages = max(2, int(pool_fraction * worst)) + 1
         srv = eng.serve(num_slots=bs, num_pages=num_pages)
         srv.warmup()
+        srv_modes = srv.kernel_modes
         util_peak = 0.0
 
         def run(srv):
@@ -1003,9 +1017,36 @@ def serving_paged_bench(model_name="opt-1.3b", *, slots_list=(96, 128, 192),
 
         run(srv)                            # compile + warm
         stalls0 = srv.stats["admission_stalls"]
+        fb0 = srv.stats["paged_attention_fallback"]
         util_peak = 0.0
         dt = run(srv)
         useful = int(np.sum(new_lens))
+        # decode-only roofline window (docs/observability.md "Device
+        # memory & roofline"): park one short request per slot in steady
+        # decode, then time pure decode dispatches — no admissions or
+        # prefill chunks interleaved — so the step time attributes the
+        # paged decode kernel itself, not the mixed scheduler loop
+        for _ in range(bs):
+            srv.submit(rng.integers(0, cfg.vocab_size, (64,))
+                       .astype(np.int32), max_new_tokens=160)
+        pf = -1
+        while srv.queue_depth or srv.stats["prefill_tokens"] != pf:
+            pf = srv.stats["prefill_tokens"]
+            srv.step()
+        live_pos = srv.page_pool_utilization * (num_pages - 1) * page_size
+        n0, t0 = srv.stats["decode_calls"], time.perf_counter()
+        while srv.stats["decode_calls"] - n0 < 8:
+            srv.step()
+        dt_win = time.perf_counter() - t0
+        steps_win = (srv.stats["decode_calls"] - n0) * decode_block
+        step_t = dt_win / max(steps_win, 1)
+        from deepspeed_tpu.profiling.roofline import (device_peaks,
+                                                      roofline_block)
+        # per-chip traffic per decode step: replicated int8 params once,
+        # live KV pages dp-sharded across chips
+        traffic = param_bytes + cfg.num_layers * live_pos * kv_row / n_dev
+        flops_step = 2.0 * param_count * bs / n_dev
+        peak_t, peak_g, peak_src = device_peaks(*_measured_peaks())
         per_bs[str(bs)] = {
             "num_slots": bs,
             "n_requests": n_requests,
@@ -1014,8 +1055,14 @@ def serving_paged_bench(model_name="opt-1.3b", *, slots_list=(96, 128, 192),
             "tokens_per_sec_chip": round(useful / dt / n_dev, 1),
             "page_pool_util_peak": round(util_peak, 3),
             "admission_stalls": srv.stats["admission_stalls"] - stalls0,
+            "paged_attention_fallback":
+                srv.stats["paged_attention_fallback"] - fb0,
+            "decode_step_ms": round(step_t * 1e3, 3),
+            "roofline": roofline_block(flops_step, traffic, step_t,
+                                       peak_t, peak_g, peak_src),
             "time_s": round(dt, 3),
         }
+        srv.drain()
         srv.close()
 
     # shared-prefix workload: one system prompt, divergent user tails —
@@ -1054,6 +1101,14 @@ def serving_paged_bench(model_name="opt-1.3b", *, slots_list=(96, 128, 192),
         "kv_cache": "int8",
         "page_size": page_size,
         "decode_block": decode_block,
+        # which attention-registry kernels the serving programs dispatch
+        # through (ops/transformer/registry.py) — pallas_paged_decode /
+        # pallas_chunked_prefill on kernel-capable backends,
+        # reference_fallback otherwise (then per_bs
+        # paged_attention_fallback counts every slow-path decode)
+        "kernel_modes": dict(srv_modes),
+        "prefill_plan": {"mode": plan_mode, "chunk": plan_chunk,
+                         "reason": plan_why},
         "per_bs": per_bs,
         "prefix_sharing": prefix,
         # the acceptance anchor: r04's bs128 monolithic int8-KV decode
@@ -1708,9 +1763,13 @@ def _phase_order(phases):
     forever, and because the incremental record is rewritten after every
     phase, each round's partial record stays a valid final-format record
     of whatever its budget afforded.  Calibration is pinned first (later
-    phases anchor their roofline math to its measured peaks) and the
+    phases anchor their roofline math to its measured peaks), the
     memory_snapshot micro-phase right behind it (the per-program memory
-    record must commit before any heavy phase can starve it)."""
+    record must commit before any heavy phase can starve it), and
+    serving_paged third: it carries the paged-attention-kernel acceptance
+    story (bs128 decode vs the r04 cliff, per-bs rooflines) and must land
+    in the NEXT record (BENCH_r06) rather than wait out a starvation
+    rotation."""
     trail = _round_trail()
 
     def staleness(key):
@@ -1719,7 +1778,7 @@ def _phase_order(phases):
                 return age
         return len(trail) + 1
 
-    pinned = ("calibrate", "memory_snapshot")
+    pinned = ("calibrate", "memory_snapshot", "serving_paged")
     index = {p[0]: i for i, p in enumerate(phases)}
     rest = sorted((p for p in phases if p[1] not in pinned),
                   key=lambda p: (-staleness(p[0]), index[p[0]]))

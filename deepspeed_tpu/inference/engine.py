@@ -306,7 +306,7 @@ class InferenceEngine:
                          f"(multiple of 8, min 8, max 512)")
         return c if c < prompt_len else None
 
-    def prefill_plan(self, batch_size, prompt_len):
+    def prefill_plan(self, batch_size, prompt_len, paged=False):
         """Which prefill pipeline ``generate(batch, prompt)`` will take,
         as ``(mode, chunk, reason)`` — ``("chunked", C, ...)`` for the
         split per-chunk path, ``("one_pass", None, ...)`` otherwise.
@@ -320,26 +320,41 @@ class InferenceEngine:
         pipeline runs fine.  Pin ``prefill_chunk_size`` to an int to
         force the chunked pipeline regardless of the kernel gate (each
         chunk then attends through ``cached_attention``'s paths, with a
-        dense per-chunk fallback of only ``[B, H, C, S_max]``)."""
+        dense per-chunk fallback of only ``[B, H, C, S_max]``).
+
+        Every reason carries a ``[kernels: ...]`` tail naming the
+        attention-registry modes the run will actually dispatch through
+        (``pallas_chunked_prefill`` / ``pallas_paged_decode`` /
+        ``pallas_decode`` / ``reference_fallback`` — see
+        ``ops/transformer/registry.py``), so bench records attribute
+        which kernel path ran, not just which pipeline was planned.
+        ``paged=True`` asks for the paged-serving attribution (block
+        tables + page-pool kernels) instead of the monolithic one."""
+        from deepspeed_tpu.ops.transformer.registry import kernel_modes
+        pe = getattr(getattr(self.module, "config", None),
+                     "position_embedding", None)
+        modes = kernel_modes(paged=bool(paged), has_bias=(pe == "alibi"))
+        tail = (" [kernels: prefill=%s decode=%s]"
+                % (modes["prefill_chunk"], modes["decode"]))
         cfg = self._config.prefill_chunk_size
         chunk = self._prefill_chunk_for(int(batch_size), int(prompt_len))
         if chunk is not None and chunk < prompt_len:
             why = "explicit prefill_chunk_size" \
                 if cfg not in ("auto",) else "auto policy accepted"
-            return "chunked", chunk, why
+            return "chunked", chunk, why + tail
         if cfg in (None, 0, "none", "off"):
-            return "one_pass", None, "chunking disabled by config"
+            return "one_pass", None, "chunking disabled by config" + tail
         if cfg == "auto":
             from deepspeed_tpu.ops.transformer.flash_attention import \
                 pallas_supported
             if not pallas_supported():
                 return ("one_pass", None,
                         "auto policy declined: Pallas chunk kernel "
-                        "unavailable on this backend")
+                        "unavailable on this backend" + tail)
             return ("one_pass", None,
                     "auto policy declined: working set under "
-                    "DSTPU_PREFILL_TOKEN_BUDGET")
-        return "one_pass", None, "chunk >= prompt_len"
+                    "DSTPU_PREFILL_TOKEN_BUDGET" + tail)
+        return "one_pass", None, "chunk >= prompt_len" + tail
 
     @hot_path("inference.generate")
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,

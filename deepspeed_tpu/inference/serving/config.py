@@ -55,6 +55,15 @@ class ServingConfig(DeepSpeedConfigModel):
     # auto to actual demand for the HBM win; admission then waits for
     # free pages under pressure (queue backpressure, never corruption)
     num_pages: int = 0
+    # Pallas paged-attention kernels (paged only): decode attends
+    # straight over the page pool through the block table (split-K
+    # across pages, online softmax, int8-KV dequant fused into the page
+    # load) and admission prefill takes the paged chunk kernel — the
+    # BENCH_r04 bs128 decode cliff fix.  False = the pre-kernel gather
+    # path (take_along_axis virtual view per layer, for A/B benching);
+    # the registry then warns once and stats["paged_attention_fallback"]
+    # counts every decode dispatch that took the slow path
+    paged_kernel: bool = True
     # copy-on-write prefix sharing (paged only): page-aligned leading
     # blocks of a prompt that hash-match an earlier prompt map to the
     # SAME physical pages, prefilled once; divergence re-prefills at

@@ -329,6 +329,9 @@ class ServingEngine:
             # page size: multiple of 8 (sublane alignment), floor 8, and
             # the virtual lane rounds UP to a whole number of pages
             self.page = max(8, -(-int(cfg.page_size) // 8) * 8)
+            # Pallas paged-attention kernels (default on); False = the
+            # pre-kernel take_along_axis gather path, for A/B benching
+            self.paged_kernel = bool(cfg.paged_kernel)
             self.cache_len = -(-self.cache_len // self.page) * self.page
             self.n_slot_pages = self.cache_len // self.page
             # pool size incl. the reserved trash page 0; auto = full
@@ -375,6 +378,18 @@ class ServingEngine:
                                     int(cfg.top_k), float(cfg.top_p))
         sampling_key = (bool(cfg.do_sample), float(cfg.temperature),
                         int(cfg.top_k), float(cfg.top_p))
+        # which attention-kernel mode each program class dispatches
+        # through (ops/transformer/registry.py — the same capability
+        # probes the traced programs take, so bench records /
+        # prefill_plan reasons attribute the path that actually ran)
+        from deepspeed_tpu.ops.transformer.registry import (
+            kernel_modes as _registry_modes)
+        _pe = getattr(getattr(self.module, "config", None),
+                      "position_embedding", None)
+        self.kernel_modes = _registry_modes(
+            paged=self.paged,
+            disabled=self.paged and not getattr(self, "paged_kernel", True),
+            has_bias=(_pe == "alibi"))
         self._decode_fn = self._propose_fn = self._verify_fn = None
         self._draft_chunk_fn = self._draft_admit_fn = None
         if self.paged:
@@ -386,17 +401,19 @@ class ServingEngine:
             if self.speculative:
                 self._verify_fn = make_paged_spec_verify_fn(
                     self.module, sample_fn, engine._deq, self.spec_k,
-                    self.cache_len)
+                    self.cache_len, paged_kernel=self.paged_kernel)
                 engine._tags[id(self._verify_fn)] = (
                     "serving_spec_verify_paged", self.num_slots,
-                    self.num_pages, self.page, self.spec_k, sampling_key)
+                    self.num_pages, self.page, self.spec_k, sampling_key,
+                    self.paged_kernel)
             else:
                 self._decode_fn = make_paged_decode_block_fn(
                     self.module, sample_fn, engine._deq, self.block,
-                    self.cache_len)
+                    self.cache_len, paged_kernel=self.paged_kernel)
                 engine._tags[id(self._decode_fn)] = (
                     "serving_decode_paged", self.num_slots,
-                    self.num_pages, self.page, self.block, sampling_key)
+                    self.num_pages, self.page, self.block, sampling_key,
+                    self.paged_kernel)
             self._admit_fn = make_paged_admit_fn(sample_fn)
             engine._tags[id(self._admit_fn)] = (
                 "serving_admit_paged", self.num_slots, sampling_key)
@@ -469,9 +486,11 @@ class ServingEngine:
             # paged prefill writes straight into the slot's pool pages
             # (no single-lane staging cache; the pool chains chunk ->
             # decode by donation)
-            self._chunk_fn = make_paged_chunk_fn(self.module, engine._deq)
+            self._chunk_fn = make_paged_chunk_fn(
+                self.module, engine._deq, paged_kernel=self.paged_kernel)
             engine._tags[id(self._chunk_fn)] = (
-                "serving_prefill_paged", self.chunk, self.page)
+                "serving_prefill_paged", self.chunk, self.page,
+                self.paged_kernel)
         else:
             self._chunk_fn = engine._make_chunk_fn()
             engine._tags[id(self._chunk_fn)] = ("serving_prefill",
@@ -558,6 +577,7 @@ class ServingEngine:
                       "resumed": 0, "prefix_lookups": 0, "prefix_hits": 0,
                       "prefix_tokens_reused": 0, "page_evictions": 0,
                       "admission_stalls": 0, "fairness_rejected": 0,
+                      "paged_attention_fallback": 0,
                       "stream_bridge_drops": 0,
                       "lock_wait_scheduler_s": 0.0,
                       "lock_wait_handler_s": 0.0}
@@ -2313,6 +2333,11 @@ class ServingEngine:
                 self._slot_last_dispatch[s] = now
         self._events.append(ev)
         self.stats["decode_calls"] += 1
+        if self.paged and self.kernel_modes["decode"] == "reference_fallback":
+            # this decode dispatch took the take_along_axis gather path
+            # (serving.paged_kernel=False, or no Pallas / alibi) — the
+            # BENCH_r04 bs128 cliff, surfaced instead of silent
+            self.stats["paged_attention_fallback"] += 1
         return True
 
     def _dispatch_spec(self, sub):  # lock-held: _lock

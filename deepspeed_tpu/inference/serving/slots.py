@@ -156,8 +156,19 @@ def make_admit_fn(sample_fn):
 # insert: it only samples the first token and flips the slot state.
 # --------------------------------------------------------------------- #
 
+def _paged_kernel_marker(paged_kernel):
+    """Cache-dict marker for ``serving.paged_kernel=False``: its PRESENCE
+    (static pytree structure) routes the attention-kernel registry back to
+    the pre-kernel ``take_along_axis`` gather path — A/B benching the
+    paged Pallas kernels without a code change.  Built INSIDE the traced
+    program so dispatch signatures (and donation) never change."""
+    if paged_kernel:
+        return {}
+    return {"paged_kernel_off": jnp.zeros((), jnp.int32)}
+
+
 def make_paged_decode_block_fn(module, sample_fn, param_transform, block,
-                               cache_len):
+                               cache_len, paged_kernel=True):
     """The paged decode step:
     ``fn(params, cache, state, pages, rng) -> (tokens, cache, state)``
     with the page POOL and the slot state donated (argnums 1, 2) and the
@@ -187,7 +198,8 @@ def make_paged_decode_block_fn(module, sample_fn, param_transform, block,
             safe_pages = jnp.where(active[:, None], pages, 0)
             logits, cache = module.apply(
                 deq(params), tok[:, None],
-                {**cache, "pages": safe_pages},
+                {**cache, "pages": safe_pages,
+                 **_paged_kernel_marker(paged_kernel)},
                 pos, method=type(module).decode)
             rng, sub = jax.random.split(rng)
             nxt = sample_fn(logits[:, -1], sub).astype(jnp.int32)
@@ -210,7 +222,7 @@ def make_paged_decode_block_fn(module, sample_fn, param_transform, block,
     return jax.jit(decode_block, donate_argnums=(1, 2))
 
 
-def make_paged_chunk_fn(module, param_transform):
+def make_paged_chunk_fn(module, param_transform, paged_kernel=True):
     """The paged admission-prefill chunk program:
     ``fn(params, cache, pages, chunk_ids, start, logits_at)`` — same
     body as the engine's per-chunk program but writing straight into the
@@ -223,7 +235,8 @@ def make_paged_chunk_fn(module, param_transform):
     @hot_path("serving.prefill_chunk_paged")
     def chunk_step(params, cache, pages, chunk_ids, start, logits_at):
         return module.apply(deq(params), chunk_ids,
-                            {**cache, "pages": pages}, start,
+                            {**cache, "pages": pages,
+                             **_paged_kernel_marker(paged_kernel)}, start,
                             method=type(module).decode,
                             logits_at=logits_at)
 
@@ -368,7 +381,7 @@ def make_spec_verify_fn(module, sample_fn, param_transform, k, cache_len):
 
 
 def make_paged_spec_verify_fn(module, sample_fn, param_transform, k,
-                              cache_len):
+                              cache_len, paged_kernel=True):
     """The PAGED verify-and-commit program: pool + slot state donated
     (argnums 1, 2), the per-slot page tables a plain traced input.  Same
     accept math as :func:`make_spec_verify_fn`; like the paged decode
@@ -382,7 +395,8 @@ def make_paged_spec_verify_fn(module, sample_fn, param_transform, k,
         safe_pages = jnp.where(state["active"][:, None], pages, 0)
         ids = jnp.concatenate([state["token"][:, None], draft], axis=1)
         logits, cache = module.apply(deq(params), ids,
-                                     {**cache, "pages": safe_pages},
+                                     {**cache, "pages": safe_pages,
+                                      **_paged_kernel_marker(paged_kernel)},
                                      state["pos"],
                                      method=type(module).decode)
         rngs = jax.random.split(rng, k + 1)

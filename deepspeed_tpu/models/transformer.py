@@ -506,40 +506,40 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
     # default precision), so single-token decode and batched prefill round
     # differently — logits agree to ~1e-2, not 1e-6.  Hardware numerics,
     # not a cache bug (the CPU mesh reproduces exact parity).
-    if S == 1 and bias is None:
+    # kernel selection goes through the ONE capability-probed dispatch
+    # table (ops/transformer/registry.py) — this function only ever sees
+    # monolithic caches (the paged pool dispatches in write_and_attend)
+    from deepspeed_tpu.ops.transformer.registry import select_kernel
+    mode = select_kernel(s=S, paged=False, has_bias=bias is not None,
+                         has_window=window is not None)
+    if mode == "pallas_decode":
         # single-token decode: the Pallas online-softmax kernel streams the
         # cache blockwise instead of materializing [B,H,1,S_max] fp32
         # logits; sliding windows (mistral-style) mask inside the kernel
         from deepspeed_tpu.ops.transformer.decode_attention import (
             decode_attention)
-        from deepspeed_tpu.ops.transformer.flash_attention import (
-            pallas_supported)
-        if pallas_supported():
-            lengths = (q_positions[:, 0] + 1).astype(jnp.int32)
-            return decode_attention(q[:, 0], k_cache, v_cache,
-                                    lengths, layer=layer,
-                                    k_scale=k_scale,
-                                    v_scale=v_scale,
-                                    window=window,
-                                    int8_matmuls=int8_matmuls)[:, None]
-    if 1 < S <= 512 and bias is None and window is None:
+        lengths = (q_positions[:, 0] + 1).astype(jnp.int32)
+        return decode_attention(q[:, 0], k_cache, v_cache,
+                                lengths, layer=layer,
+                                k_scale=k_scale,
+                                v_scale=v_scale,
+                                window=window,
+                                int8_matmuls=int8_matmuls)[:, None]
+    if mode == "pallas_chunked_prefill":
         # multi-token block vs cache (chunked prefill / incremental
         # multi-token feed): the chunk kernel keeps score tiles at
         # [S, block_k] and never dequantizes the whole cache — the dense
         # fallback below materializes [B, H, S, S_max] fp32 scores (and,
         # quantized, a full-precision cache copy) per layer.  S is capped
-        # at 512: the kernel's q block and f32 accumulator scale with
-        # S x H x D and would blow VMEM on longer blocks — those keep the
-        # dense HBM fallback.
+        # at MAX_CHUNK_S (512): the kernel's q block and f32 accumulator
+        # scale with S x H x D and would blow VMEM on longer blocks —
+        # those keep the dense HBM fallback.
         from deepspeed_tpu.ops.transformer.decode_attention import (
             chunk_prefill_attention)
-        from deepspeed_tpu.ops.transformer.flash_attention import (
-            pallas_supported)
-        if pallas_supported():
-            starts = q_positions[:, 0].astype(jnp.int32)
-            return chunk_prefill_attention(q, k_cache, v_cache, starts,
-                                           layer=layer, k_scale=k_scale,
-                                           v_scale=v_scale)
+        starts = q_positions[:, 0].astype(jnp.int32)
+        return chunk_prefill_attention(q, k_cache, v_cache, starts,
+                                       layer=layer, k_scale=k_scale,
+                                       v_scale=v_scale)
     if layer is not None:
         # dense fallback needs the layer slice after all
         sl = lambda c: jax.lax.dynamic_index_in_dim(c, layer, 0,
@@ -588,57 +588,6 @@ def cached_attention(q, k_cache, v_cache, q_positions, bias=None,
     logits = jnp.where(ok, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhst,bhtd->bshd", probs, v_cache)
-
-
-def _fused_decode_step(cfg, q, k, v, positions, cache, bias, window, S_):
-    """Single-token decode through the FUSED-WRITE kernel: the kernel
-    writes this step's K/V row (quantizing when the cache is int8) into
-    the caches via aliased outputs AND attends — no out-of-kernel
-    dynamic_update_slice on the multi-GB cache at all.  Returns
-    ``(out [B,1,H,D], new_cache)`` or None when this step must take the
-    write-then-attend path (multi-token, alibi bias, the opt-in int8-MXU
-    mode, or no Pallas).
-
-    Why this exists: the DUS chain interleaved with the kernel's cache
-    reads makes XLA copy the cache per step once it exceeds ~2.2 GB
-    (measured 129 ms/step vs 12.7 fused at bs16 x 4k x 24 layers) — the
-    in-place write the reference gets from its workspace pointer
-    arithmetic (``inference_context.h:24-87``) has to live INSIDE the
-    kernel here."""
-    if S_ != 1 or bias is not None or cfg.decode_int8_matmuls \
-            or "pages" in cache:
-        # paged caches scatter through the page table instead — the fused
-        # kernel's aliased write stripe assumes the monolithic layout
-        return None
-    if cache["k"].shape[-2] % 8 != 0:
-        # the write-stripe outputs are 8-sublane-aligned blocks; odd cache
-        # lengths (hand-allocated test caches) take the unfused path
-        # (required_cache_len rounds engine workspaces to a multiple of 8)
-        return None
-    from deepspeed_tpu.ops.transformer.decode_attention import (
-        decode_attention)
-    from deepspeed_tpu.ops.transformer.flash_attention import (
-        pallas_supported)
-    if not pallas_supported():
-        return None
-    lengths = (positions[:, 0] + 1).astype(jnp.int32)
-    res = decode_attention(q[:, 0], cache["k"], cache["v"], lengths,
-                           layer=cache.get("layer"),
-                           k_scale=cache.get("k_scale"),
-                           v_scale=cache.get("v_scale"),
-                           window=window,
-                           new_k=k[:, 0], new_v=v[:, 0])
-    if cfg.kv_cache_quant:
-        out_f, kc, vc, ksc, vsc = res
-        data = {"k": kc, "v": vc, "k_scale": ksc, "v_scale": vsc}
-    else:
-        out_f, kc, vc = res
-        data = {"k": kc, "v": vc}
-    new_cache = dict(
-        data,
-        **({"layer": cache["layer"]} if "layer" in cache else {}),
-        **({"per_row": cache["per_row"]} if "per_row" in cache else {}))
-    return out_f[:, None], new_cache
 
 
 class Attention(nn.Module):
@@ -693,162 +642,18 @@ class Attention(nn.Module):
             # write this step's k/v at the current position, attend over
             # cache; cache layout is [.., S_max, KVH*D] (S-major, heads
             # flattened — the decode kernel's full-lane-width DMA layout;
-            # the write below is the raw projection output, no transpose)
-            B_, S_ = k.shape[0], k.shape[1]
-            # A full prefill (multi-token block starting at position 0 —
-            # the `prefill` static flag, set by hidden_states where the
-            # start is still statically visible) attends only within
-            # itself: route it through the flash/causal path on the fresh
-            # q/k/v instead of cached_attention's dense fallback, whose
-            # [B, H, S, S_max] fp32 score tensor is ~33 GB at a 4k
-            # prompt.  The cache is still written below; only the attend
-            # swaps.  (Alibi models keep the dense path: their bias is
-            # sized to the cache, not the prompt.)
-            prefill_from_zero = bool(prefill) and S_ > 1 and bias is None
-            fused = _fused_decode_step(cfg, q, k, v, positions, cache,
-                                       bias, window, S_)
-            if fused is not None:
-                out, new_cache = fused
-                proj = dense(features=cfg.hidden_size, axis=(-2, -1),
-                             use_bias=cfg.attn_out_bias_enabled,
-                             name="o_proj")(
-                    out.reshape(*out.shape[:2], H, D))
-                return proj, new_cache
-            k_new = k.reshape(B_, S_, KVH * D)
-            v_new = v.reshape(B_, S_, KVH * D)
-            ks_new = vs_new = None
-            if cfg.kv_cache_quant:
-                # per-(position, kv-head) symmetric int8: the scale rides a
-                # tiny side buffer; the payload write below stays the raw
-                # projection-output layout
-                def quant_rows(new):
-                    r = new.reshape(B_, S_, KVH, D).astype(jnp.float32)
-                    s = jnp.max(jnp.abs(r), axis=-1) / 127.0
-                    safe = jnp.where(s == 0.0, 1.0, s)
-                    pay = jnp.clip(jnp.round(r / safe[..., None]),
-                                   -127, 127)
-                    return pay.reshape(B_, S_, KVH * D), s
-                k_new, ks_new = quant_rows(k_new)
-                v_new, vs_new = quant_rows(v_new)
-            if S_ == 1 and "per_row" in cache:
-                # padded-prompt decode: each row writes at ITS OWN position
-                # (generated tokens overwrite the right-pad slots, keeping
-                # the live cache region contiguous for the decode kernel's
-                # length mask).  One native scatter — NOT the default path:
-                # the row-uniform dynamic_update_slice below is cheaper and
-                # proven on the big stacked cache.
-                pos_rows = positions[:, 0]
-                rows = jnp.arange(B_)
-
-                def write_rows(buf, new, li=None):
-                    # buf [L, B, S, KD] or [B, S, KD], new [B, 1, KD]
-                    if li is None:
-                        return buf.at[rows, pos_rows].set(
-                            new[:, 0].astype(buf.dtype))
-                    return buf.at[li, rows, pos_rows].set(
-                        new[:, 0].astype(buf.dtype))
-            elif "per_row" in cache:
-                # per-row MULTI-token block (the serving engine's
-                # speculative verify): each row writes S_ contiguous
-                # positions from ITS OWN start in one batched scatter.
-                # Positions past the buffer (dead lanes' clamped
-                # windows) are dropped by scatter's out-of-bounds rule;
-                # in-bounds writes land inside the row's own lane.
-                rows2d = jnp.arange(B_)[:, None]             # [B, 1]
-
-                def write_rows(buf, new, li=None):
-                    # buf [L, B, S, KD] or [B, S, KD], new [B, S_, KD]
-                    if li is None:
-                        return buf.at[rows2d, positions].set(
-                            new.astype(buf.dtype))
-                    return buf.at[li, rows2d, positions].set(
-                        new.astype(buf.dtype))
-            else:
-                # row-uniform write: decode at a shared position, or a
-                # multi-token prefill block from the start position
-                start = positions[0, 0]
-
-                def write_rows(buf, new, li=None):
-                    if li is None:
-                        return jax.lax.dynamic_update_slice(
-                            buf, new.astype(buf.dtype), (0, start, 0))
-                    return jax.lax.dynamic_update_slice(
-                        buf, new[None].astype(buf.dtype), (li, 0, start, 0))
-            if "pages" in cache:
-                # PAGED cache (serving block tables, docs/serving.md):
-                # the pool is [L, num_pages, page, KVH*D] and the page
-                # table rides the cache dict as a traced argument.  Write
-                # through the table (one batched scatter), attend over
-                # the gathered per-layer virtual view — page allocation,
-                # sharing and reuse are entirely the host scheduler's
-                # business, so admissions/retirements/prefix hits never
-                # change this program's shape.
-                data = _paged_write(
-                    cache, k_new, v_new, ks_new, vs_new, positions,
-                    per_row=("per_row" in cache))
-                new_cache = {**data, "layer": cache["layer"],
-                             "pages": cache["pages"],
-                             **({"per_row": cache["per_row"]}
-                                if "per_row" in cache else {})}
-                if not prefill_from_zero:
-                    g = _paged_gather(new_cache)
-                    out = cached_attention(
-                        q, g["k"], g["v"], positions, bias=bias,
-                        window=window, k_scale=g.get("k_scale"),
-                        v_scale=g.get("v_scale"),
-                        int8_matmuls=cfg.decode_int8_matmuls)
-            elif "layer" in cache:
-                # stacked-carry decode: the FULL [L, B, S_max, KVH*D]
-                # cache rides the layer-scan carry and only this step's
-                # tokens are written — never a full-cache rewrite per
-                # token (the nn.scan ys path re-materialized ~the whole
-                # cache every decode step).  The Pallas decode kernel
-                # indexes the layer itself, so no slice materializes.
-                li = cache["layer"]
-                k_full = write_rows(cache["k"], k_new, li)
-                v_full = write_rows(cache["v"], v_new, li)
-                scales = {}
-                if ks_new is not None:
-                    scales = {"k_scale": write_rows(cache["k_scale"],
-                                                    ks_new, li),
-                              "v_scale": write_rows(cache["v_scale"],
-                                                    vs_new, li)}
-                if not prefill_from_zero:
-                    out = cached_attention(
-                        q, k_full, v_full, positions,
-                        bias=bias, window=window, layer=li,
-                        k_scale=scales.get("k_scale"),
-                        v_scale=scales.get("v_scale"),
-                        int8_matmuls=cfg.decode_int8_matmuls)
-                new_cache = {"k": k_full, "v": v_full, **scales,
-                             "layer": li,
-                             **({"per_row": cache["per_row"]}
-                                if "per_row" in cache else {})}
-            else:
-                k_cache = write_rows(cache["k"], k_new)
-                v_cache = write_rows(cache["v"], v_new)
-                scales = {}
-                if ks_new is not None:
-                    scales = {"k_scale": write_rows(cache["k_scale"],
-                                                    ks_new),
-                              "v_scale": write_rows(cache["v_scale"],
-                                                    vs_new)}
-                new_cache = {"k": k_cache, "v": v_cache, **scales,
-                             **({"per_row": cache["per_row"]}
-                                if "per_row" in cache else {})}
-                if not prefill_from_zero:
-                    out = cached_attention(
-                        q, k_cache, v_cache, positions,
-                        bias=bias, window=window,
-                        k_scale=scales.get("k_scale"),
-                        v_scale=scales.get("v_scale"),
-                        int8_matmuls=cfg.decode_int8_matmuls)
-            if prefill_from_zero:
-                # one shared prefill attend for both cache layouts: the
-                # cache was written above; the attention itself is plain
-                # causal flash over this block's fresh q/k/v (bias is
-                # None by the prefill_from_zero condition)
-                out = _prefill_attention(q, k, v, cfg, window=window)
+            # the write is the raw projection output, no transpose).
+            # ALL cache layouts (monolithic / layer-stacked / paged pool)
+            # and program classes (decode, chunked prefill, speculative
+            # verify) go through the ONE kernel-registry dispatch point —
+            # write form, kernel selection (capability-probed), the fused
+            # aliased decode write, and the reference/gather fallback all
+            # live there (ops/transformer/registry.py).
+            from deepspeed_tpu.ops.transformer.registry import (
+                write_and_attend)
+            out, new_cache = write_and_attend(
+                cfg, q, k, v, positions, cache, bias=bias, window=window,
+                prefill=prefill)
         else:
             out = _attention(q, k, v, cfg, mask=mask, bias=bias,
                              window=window)
@@ -1058,6 +863,12 @@ class Transformer(nn.Module):
             # paged pool: the per-row page table threads every layer's
             # cache dict unchanged (pages are constant across layers)
             marker["pages"] = cache["pages"]
+            if "paged_kernel_off" in cache:
+                # serving.paged_kernel=False: the registry routes paged
+                # attention back to the gather path.  STATIC pytree
+                # structure (like per_row) — flipping the knob is a
+                # different program, never a retrace surprise
+                marker["paged_kernel_off"] = cache["paged_kernel_off"]
         # from-zero multi-token prefill, decided where the start is
         # still STATICALLY visible (generation passes a literal 0;
         # inside the remat-wrapped block `positions` is a tracer):

@@ -1,0 +1,359 @@
+"""Attention-kernel registry — the ONE dispatch point for cached attention.
+
+Every cached-attention consumer (the monolithic generate()/serving decode
+path, the paged serving path, speculative verify's per-row multi-token
+blocks, chunked prefill) routes through this module instead of
+hand-threading its own "which kernel can run here?" branch:
+
+* :func:`select_kernel` — the capability-probed dispatch table.  Probes
+  are STATIC (shapes, config, backend support — never traced values), so
+  the decision is made once at trace time and every caller, including the
+  host-side attribution in ``InferenceEngine.prefill_plan()`` and the
+  serving engine's stats, sees the same answer the compiled program took.
+* :func:`write_and_attend` — the single write-then-attend implementation
+  behind ``models.transformer.Attention``: cache-layout resolution
+  (monolithic / layer-stacked / paged pool), this step's K/V row write
+  (scatter, DUS or kernel-fused aliased write), and the attend through
+  the selected kernel.  This collapses what used to be three near-copies
+  of the write/gather branch in ``Attention.__call__`` plus the separate
+  fused-decode special case.
+
+Modes (the ``KERNEL_MODES`` table, probed in order, first hit wins):
+
+==========================  ==================================================
+``pallas_paged_decode``     single-token decode straight over the paged pool
+                            (``ops/transformer/paged_attention.py``) — split-K
+                            across block-table pages, no gathered virtual view
+``pallas_decode``           single-token decode over a monolithic cache
+                            (``ops/transformer/decode_attention.py``)
+``pallas_chunked_prefill``  multi-token block (chunked prefill, multi-token
+                            decode, speculative verify) vs either cache
+                            layout, S <= MAX_CHUNK_S
+``reference_fallback``      the XLA reference path: paged caches first
+                            materialize the ``take_along_axis`` gathered view
+                            (``_paged_gather``) and then take whatever
+                            ``cached_attention`` does on it — dense masked
+                            attention when Pallas is unavailable or a bias
+                            rides along.  Paged DECODE landing here is the
+                            BENCH_r04 bs128 cliff: it warns once and the
+                            serving engine counts it
+                            (``stats["paged_attention_fallback"]``)
+==========================  ==================================================
+
+A paged cache opts out of the Pallas paged kernels (back to the gather
+path, e.g. for A/B benching) via ``ServingConfig.paged_kernel=False``,
+which rides the cache dict as a ``paged_kernel_off`` marker — STATIC
+pytree structure, so flipping it is a different program, never a retrace
+surprise.
+"""
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.transformer.flash_attention import pallas_supported
+from deepspeed_tpu.utils.logging import warning_once
+
+# the chunk kernel's q block + f32 accumulator scale with S x H x D;
+# longer blocks would blow VMEM and keep the dense fallback
+MAX_CHUNK_S = 512
+
+# marker key on a cache dict: paged Pallas kernels disabled
+# (ServingConfig.paged_kernel=False) — presence only, value unused
+PAGED_KERNEL_OFF = "paged_kernel_off"
+
+KERNEL_MODES = (
+    "pallas_paged_decode",
+    "pallas_decode",
+    "pallas_chunked_prefill",
+    "reference_fallback",
+)
+
+
+def _probe_paged_decode(s, paged, has_bias, has_window, disabled):
+    # the paged kernel has no sliding-window mode: windowed paged decode
+    # keeps the gather path (whose monolithic kernel masks the window)
+    return (paged and s == 1 and not has_bias and not has_window
+            and not disabled and pallas_supported())
+
+
+def _probe_decode(s, paged, has_bias, has_window, disabled):
+    # monolithic decode masks sliding windows in-kernel
+    return (not paged and s == 1 and not has_bias and pallas_supported())
+
+
+def _probe_chunk(s, paged, has_bias, has_window, disabled):
+    return (1 < s <= MAX_CHUNK_S and not has_bias and not has_window
+            and not (paged and disabled) and pallas_supported())
+
+
+_REGISTRY = (
+    ("pallas_paged_decode", _probe_paged_decode),
+    ("pallas_decode", _probe_decode),
+    ("pallas_chunked_prefill", _probe_chunk),
+)
+
+
+def select_kernel(*, s, paged=False, has_bias=False, has_window=False,
+                  disabled=False):
+    """The attention-kernel dispatch decision for one cached-attention
+    call.  All inputs are static: ``s`` (this block's token count),
+    ``paged`` (block-table pool vs monolithic lanes), ``has_bias``
+    (alibi), ``has_window`` (sliding-window layer) and ``disabled``
+    (the cache's ``paged_kernel_off`` marker).  Returns a
+    :data:`KERNEL_MODES` name; ``reference_fallback`` when no Pallas
+    kernel applies."""
+    for mode, probe in _REGISTRY:
+        if probe(s, paged, has_bias, has_window, disabled):
+            return mode
+    return "reference_fallback"
+
+
+def kernel_modes(*, paged, disabled=False, has_bias=False,
+                 has_window=False):
+    """Host-side attribution of which kernel mode each serving program
+    class will take (what ``prefill_plan`` reasons and bench records
+    report).  Probes the same table the traced programs dispatch
+    through, so the attribution cannot drift from reality."""
+    return {
+        "decode": select_kernel(s=1, paged=paged, has_bias=has_bias,
+                                has_window=has_window, disabled=disabled),
+        "prefill_chunk": select_kernel(s=2, paged=paged, has_bias=has_bias,
+                                       has_window=has_window,
+                                       disabled=disabled),
+    }
+
+
+def _cache_markers(cache):
+    """The bookkeeping keys a write must thread through unchanged."""
+    return {kk: cache[kk]
+            for kk in ("layer", "pages", "per_row", PAGED_KERNEL_OFF)
+            if kk in cache}
+
+
+def _quant_rows(new, kvh):
+    """Per-(position, kv-head) symmetric int8 for this step's rows: the
+    scale rides a tiny side buffer; the payload keeps the raw
+    projection-output layout."""
+    B_, S_, KVHD = new.shape
+    r = new.reshape(B_, S_, kvh, KVHD // kvh).astype(jnp.float32)
+    s = jnp.max(jnp.abs(r), axis=-1) / 127.0
+    safe = jnp.where(s == 0.0, 1.0, s)
+    pay = jnp.clip(jnp.round(r / safe[..., None]), -127, 127)
+    return pay.reshape(B_, S_, KVHD), s
+
+
+def _write_cache(cache, k_new, v_new, ks_new, vs_new, positions):
+    """This step's K/V rows into the cache — ONE implementation of what
+    used to be three branch copies: paged pools scatter through the page
+    table; monolithic caches (layer-stacked or per-layer) pick the
+    per-row-single-token scatter, the per-row multi-token scatter
+    (speculative verify) or the row-uniform dynamic_update_slice."""
+    import jax
+    from deepspeed_tpu.models.transformer import _paged_write
+    markers = _cache_markers(cache)
+    if "pages" in cache:
+        data = _paged_write(cache, k_new, v_new, ks_new, vs_new, positions,
+                            per_row=("per_row" in cache))
+        return {**data, **markers}
+    B_, S_ = k_new.shape[0], k_new.shape[1]
+    li = cache.get("layer")
+    if "per_row" in cache and S_ == 1:
+        # padded-prompt decode: each row writes at ITS OWN position
+        # (generated tokens overwrite the right-pad slots, keeping the
+        # live cache region contiguous for the decode kernel's length
+        # mask).  One native scatter — NOT the default path: the
+        # row-uniform dynamic_update_slice below is cheaper and proven
+        # on the big stacked cache.
+        pos_rows = positions[:, 0]
+        rows = jnp.arange(B_)
+
+        def write_rows(buf, new):
+            if li is None:
+                return buf.at[rows, pos_rows].set(
+                    new[:, 0].astype(buf.dtype))
+            return buf.at[li, rows, pos_rows].set(
+                new[:, 0].astype(buf.dtype))
+    elif "per_row" in cache:
+        # per-row MULTI-token block (the serving engine's speculative
+        # verify): each row writes S_ contiguous positions from ITS OWN
+        # start in one batched scatter.  Positions past the buffer (dead
+        # lanes' clamped windows) are dropped by scatter's out-of-bounds
+        # rule; in-bounds writes land inside the row's own lane.
+        rows2d = jnp.arange(B_)[:, None]                 # [B, 1]
+
+        def write_rows(buf, new):
+            if li is None:
+                return buf.at[rows2d, positions].set(new.astype(buf.dtype))
+            return buf.at[li, rows2d, positions].set(new.astype(buf.dtype))
+    else:
+        # row-uniform write: decode at a shared position, or a
+        # multi-token prefill block from the start position
+        start = positions[0, 0]
+
+        def write_rows(buf, new):
+            if li is None:
+                return jax.lax.dynamic_update_slice(
+                    buf, new.astype(buf.dtype), (0, start, 0))
+            return jax.lax.dynamic_update_slice(
+                buf, new[None].astype(buf.dtype), (li, 0, start, 0))
+
+    data = {"k": write_rows(cache["k"], k_new),
+            "v": write_rows(cache["v"], v_new)}
+    if ks_new is not None:
+        data["k_scale"] = write_rows(cache["k_scale"], ks_new)
+        data["v_scale"] = write_rows(cache["v_scale"], vs_new)
+    return {**data, **markers}
+
+
+def _fused_decode(cfg, q, k, v, positions, cache, mode, window):
+    """Single-token decode through the FUSED-WRITE kernels: the kernel
+    writes this step's K/V row (quantizing when the cache is int8) via
+    aliased outputs AND attends — no out-of-kernel scatter /
+    dynamic_update_slice on the multi-GB cache at all.  Returns
+    ``(out [B,1,H,D], new_cache)`` or None when this step must take the
+    write-then-attend path (the opt-in int8-MXU mode, unaligned
+    layouts, or a non-decode kernel mode).
+
+    Why this exists: the out-of-kernel cache-update chain interleaved
+    with the kernel's cache reads makes XLA copy the cache per step once
+    it exceeds ~2.2 GB (measured 129 ms/step vs 12.7 fused at
+    bs16 x 4k x 24 layers) — the in-place write the reference gets from
+    its workspace pointer arithmetic (``inference_context.h:24-87``)
+    has to live INSIDE the kernel here."""
+    if cfg.decode_int8_matmuls:
+        # the int8-MXU score/PV matmuls are unsupported with the fused
+        # write (per-row requantization would race the aliased stripe)
+        return None
+    lengths = (positions[:, 0] + 1).astype(jnp.int32)
+    if mode == "pallas_paged_decode":
+        if cache["k"].shape[-2] % 8 != 0:
+            # write stripes are 8-sublane-aligned; ServingConfig rounds
+            # page_size to a multiple of 8, hand-built pools may not
+            return None
+        from deepspeed_tpu.ops.transformer.paged_attention import (
+            paged_decode_attention)
+        res = paged_decode_attention(
+            q[:, 0], cache["k"], cache["v"], lengths, cache["pages"],
+            layer=cache["layer"], k_scale=cache.get("k_scale"),
+            v_scale=cache.get("v_scale"), new_k=k[:, 0], new_v=v[:, 0])
+    elif mode == "pallas_decode":
+        if cache["k"].shape[-2] % 8 != 0:
+            # odd cache lengths (hand-allocated test caches) take the
+            # unfused path (required_cache_len rounds engine workspaces
+            # to a multiple of 8)
+            return None
+        from deepspeed_tpu.ops.transformer.decode_attention import (
+            decode_attention)
+        res = decode_attention(
+            q[:, 0], cache["k"], cache["v"], lengths,
+            layer=cache.get("layer"), k_scale=cache.get("k_scale"),
+            v_scale=cache.get("v_scale"), window=window,
+            new_k=k[:, 0], new_v=v[:, 0])
+    else:
+        return None
+    if cfg.kv_cache_quant:
+        out_f, kc, vc, ksc, vsc = res
+        data = {"k": kc, "v": vc, "k_scale": ksc, "v_scale": vsc}
+    else:
+        out_f, kc, vc = res
+        data = {"k": kc, "v": vc}
+    return out_f[:, None], {**data, **_cache_markers(cache)}
+
+
+def _attend(cfg, mode, q, cache, positions, bias, window):
+    """The attend half, through the selected kernel mode."""
+    from deepspeed_tpu.models.transformer import (_paged_gather,
+                                                  cached_attention)
+    if "pages" in cache:
+        if mode == "pallas_paged_decode":
+            from deepspeed_tpu.ops.transformer.paged_attention import (
+                paged_decode_attention)
+            lengths = (positions[:, 0] + 1).astype(jnp.int32)
+            return paged_decode_attention(
+                q[:, 0], cache["k"], cache["v"], lengths, cache["pages"],
+                layer=cache["layer"], k_scale=cache.get("k_scale"),
+                v_scale=cache.get("v_scale"),
+                int8_matmuls=cfg.decode_int8_matmuls)[:, None]
+        if mode == "pallas_chunked_prefill":
+            from deepspeed_tpu.ops.transformer.paged_attention import (
+                paged_chunk_prefill_attention)
+            starts = positions[:, 0].astype(jnp.int32)
+            return paged_chunk_prefill_attention(
+                q, cache["k"], cache["v"], starts, cache["pages"],
+                layer=cache["layer"], k_scale=cache.get("k_scale"),
+                v_scale=cache.get("v_scale"))
+        # reference/gather fallback — the pre-kernel paged path: one
+        # take_along_axis virtual-view copy per layer, then whatever
+        # cached_attention does on the monolithic view.  For DECODE this
+        # is the BENCH_r04 bs128 cliff, so it never happens silently.
+        if q.shape[1] == 1:
+            warning_once(
+                "paged decode fell back to the take_along_axis gather "
+                "path (" + _fallback_reason(cfg, bias, window, cache)
+                + ") — expect the BENCH_r04 bs128 decode cliff; see "
+                "docs/serving.md 'Paged attention kernels'")
+        g = _paged_gather(cache)
+        return cached_attention(
+            q, g["k"], g["v"], positions, bias=bias, window=window,
+            k_scale=g.get("k_scale"), v_scale=g.get("v_scale"),
+            int8_matmuls=cfg.decode_int8_matmuls)
+    layer = cache.get("layer")
+    return cached_attention(
+        q, cache["k"], cache["v"], positions, bias=bias, window=window,
+        layer=layer, k_scale=cache.get("k_scale"),
+        v_scale=cache.get("v_scale"),
+        int8_matmuls=cfg.decode_int8_matmuls)
+
+
+def _fallback_reason(cfg, bias, window, cache):
+    if PAGED_KERNEL_OFF in cache:
+        return "serving.paged_kernel=False"
+    if bias is not None:
+        return "alibi bias"
+    if window is not None:
+        return "sliding-window layer"
+    if not pallas_supported():
+        return "no Pallas support on this backend"
+    return "unsupported configuration"
+
+
+def write_and_attend(cfg, q, k, v, positions, cache, *, bias=None,
+                     window=None, prefill=False):
+    """Write this step's K/V rows into the cache and attend — the single
+    entry point behind ``Attention.__call__``'s cached path for EVERY
+    cache layout and program class.  Returns ``(out [B,S,H,D],
+    new_cache)``.
+
+    ``prefill`` (static): a from-zero multi-token block attends only
+    within itself — the attend swaps to causal flash over the fresh
+    q/k/v (the dense cached fallback would materialize a [B, H, S,
+    S_max] fp32 score tensor, ~33 GB at a 4k prompt); the cache write
+    still happens.  (Alibi models keep the dense path: their bias is
+    sized to the cache, not the prompt.)"""
+    from deepspeed_tpu.models.transformer import _prefill_attention
+    B_, S_ = k.shape[0], k.shape[1]
+    KVHD = k.shape[-2] * k.shape[-1]
+    paged = "pages" in cache
+    disabled = PAGED_KERNEL_OFF in cache
+    prefill_from_zero = bool(prefill) and S_ > 1 and bias is None
+    mode = select_kernel(s=S_, paged=paged, has_bias=bias is not None,
+                         has_window=window is not None, disabled=disabled)
+    if not prefill_from_zero:
+        fused = _fused_decode(cfg, q, k, v, positions, cache, mode, window)
+        if fused is not None:
+            return fused
+    k_new = k.reshape(B_, S_, KVHD)
+    v_new = v.reshape(B_, S_, KVHD)
+    ks_new = vs_new = None
+    if cfg.kv_cache_quant:
+        kvh = k.shape[-2]
+        k_new, ks_new = _quant_rows(k_new, kvh)
+        v_new, vs_new = _quant_rows(v_new, kvh)
+    new_cache = _write_cache(cache, k_new, v_new, ks_new, vs_new, positions)
+    if prefill_from_zero:
+        # one shared prefill attend for every cache layout: the cache
+        # was written above; the attention itself is plain causal flash
+        # over this block's fresh q/k/v
+        out = _prefill_attention(q, k, v, cfg, window=window)
+    else:
+        out = _attend(cfg, mode, q, new_cache, positions, bias, window)
+    return out, new_cache

@@ -99,15 +99,23 @@ def ensure_harness_env():
 # Extraction
 # --------------------------------------------------------------------- #
 def _walk_counts(jaxpr, out):
+    # a param holding a ClosedJaxpr exposes ``.jaxpr``; remat2 and
+    # pallas_call carry a RAW Jaxpr (``.eqns``, no ``.jaxpr``) — missing
+    # that second shape would leave every rematerialized attention body
+    # (and the Pallas kernel bodies inside it) out of the multiset
     for eqn in jaxpr.eqns:
         out[eqn.primitive.name] = out.get(eqn.primitive.name, 0) + 1
         for v in eqn.params.values():
             sub = getattr(v, "jaxpr", None)
+            if sub is None and hasattr(v, "eqns"):
+                sub = v
             if sub is not None:
                 _walk_counts(sub, out)
             elif isinstance(v, (list, tuple)):
                 for item in v:
                     sub = getattr(item, "jaxpr", None)
+                    if sub is None and hasattr(item, "eqns"):
+                        sub = item
                     if sub is not None:
                         _walk_counts(sub, out)
     return out

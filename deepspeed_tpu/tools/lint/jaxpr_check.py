@@ -40,15 +40,22 @@ class CheckResult:
 
 
 def _walk_primitives(jaxpr, out):
+    # ClosedJaxpr params expose ``.jaxpr``; remat2 and pallas_call carry
+    # a RAW Jaxpr (``.eqns`` only) — both shapes must recurse or the
+    # callback gate goes blind inside rematerialized attention bodies
     for eqn in jaxpr.eqns:
         out.add(eqn.primitive.name)
         for v in eqn.params.values():
             sub = getattr(v, "jaxpr", None)
+            if sub is None and hasattr(v, "eqns"):
+                sub = v
             if sub is not None:
                 _walk_primitives(sub, out)
             elif isinstance(v, (list, tuple)):
                 for item in v:
                     sub = getattr(item, "jaxpr", None)
+                    if sub is None and hasattr(item, "eqns"):
+                        sub = item
                     if sub is not None:
                         _walk_primitives(sub, out)
 

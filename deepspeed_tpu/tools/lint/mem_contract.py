@@ -80,7 +80,25 @@ MEM_ABS_FLOOR = 1024
 # once the grown contract is locked (the declaration is stamped into
 # the lock as ``memory_growth_declared``), the next PR removes the
 # entry and the ratchet re-arms.
-DECLARED_GROWTH = {}
+DECLARED_GROWTH = {
+    # The paged serving programs now run the Pallas paged-attention /
+    # chunked-prefill kernels instead of the per-layer take_along_axis
+    # gather.  On the CPU contract harness pallas_call runs in
+    # interpret mode, which materialises each page block as a real HBM
+    # temp and keeps the fused pool write as an extra output copy; on
+    # TPU those are VMEM scratch and a true input_output_alias.  The
+    # growth is tens of KB at the toy contract shapes and trades away a
+    # full gathered-pool copy per layer per step at real shapes.
+    "serving.decode_step_paged":
+        "Pallas paged-decode kernel: interpret-mode page-block temps + "
+        "fused pool-write aliasing replace the take_along_axis gather",
+    "serving.prefill_chunk_paged":
+        "Pallas chunked-prefill kernel: interpret-mode page-block temps "
+        "replace the take_along_axis gather",
+    "serving.spec_verify_paged":
+        "Pallas chunked-prefill kernel (spec verify path): "
+        "interpret-mode page-block temps replace the gather",
+}
 
 
 # ------------------------------------------------------------------ #

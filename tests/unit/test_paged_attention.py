@@ -1,0 +1,289 @@
+"""Pallas paged-attention kernel tests (``ops/transformer/paged_attention.py``)
+and the attention-kernel registry (``ops/transformer/registry.py``).
+
+The kernel contract: paged decode/chunk-prefill over the page pool is
+BITWISE equal to the ``take_along_axis`` gather reference — the gathered
+virtual view fed to the monolithic kernel at ``block_k = page_size``,
+which walks the identical online-softmax block sequence — across page
+sizes {16, 64, 128}, fp32 and int8-KV pools, dead lanes and unaligned
+lengths.  (Serving-level mid-stream EOS / slot-churn / greedy-bitwise
+coverage rides ``test_serving_paged.py``, which now exercises these
+kernels end to end.)  The registry contract: one static dispatch table,
+probed identically by the traced programs and the host-side attribution,
+reference fallback warns instead of silently re-creating the BENCH_r04
+cliff, and the traced paged decode step stays host-callback-free with
+its fused write aliased in the jaxpr.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.transformer.decode_attention import (
+    chunk_prefill_attention, decode_attention)
+from deepspeed_tpu.ops.transformer.paged_attention import (
+    paged_chunk_prefill_attention, paged_decode_attention)
+from deepspeed_tpu.ops.transformer.registry import (
+    MAX_CHUNK_S, kernel_modes, select_kernel)
+
+L, B, H, KVH, D = 2, 3, 4, 2, 8
+KVHD = KVH * D
+LAYER = 1
+
+
+def _pool_fixture(page, *, int8=False, seed=0):
+    """A small pool + block tables with a dead lane (length 0, table all
+    trash page 0) and unaligned live lengths."""
+    rng = np.random.RandomState(seed)
+    nvirt = 4
+    P = 3 * nvirt + 1                       # worst case + trash page 0
+    shape = (L, P, page, KVHD)
+    if int8:
+        k = jnp.asarray(rng.randint(-127, 128, shape), jnp.int8)
+        v = jnp.asarray(rng.randint(-127, 128, shape), jnp.int8)
+        ks = jnp.asarray(rng.rand(L, P, page, KVH) * 0.1 + 0.01, jnp.float32)
+        vs = jnp.asarray(rng.rand(L, P, page, KVH) * 0.1 + 0.01, jnp.float32)
+    else:
+        k = jnp.asarray(rng.randn(*shape), jnp.float32)
+        v = jnp.asarray(rng.randn(*shape), jnp.float32)
+        ks = vs = None
+    # non-contiguous, non-monotone physical pages; row 2 is a dead lane
+    pages = jnp.asarray([[3, 5, 2, 7], [1, 4, 6, 8], [0, 0, 0, 0]],
+                        jnp.int32)
+    lengths = jnp.asarray([2 * page + 5, 4 * page, 0], jnp.int32)
+    q = jnp.asarray(rng.randn(B, H, D), jnp.float32)
+    return q, k, v, ks, vs, pages, lengths, nvirt
+
+
+def _gather(buf, pages, nvirt):
+    """The take_along_axis reference view: [B, nvirt*page, last-dim]."""
+    return buf[LAYER, pages].reshape(B, nvirt * buf.shape[2], buf.shape[-1])
+
+
+@pytest.mark.parametrize("page", [16, 64, 128])
+@pytest.mark.parametrize("int8", [False, True], ids=["fp32", "int8"])
+def test_paged_decode_bitwise_vs_gather(page, int8):
+    """Decode over the pool == decode over the gathered virtual view,
+    BITWISE (live rows; the dead lane's output is garbage either way)."""
+    q, k, v, ks, vs, pages, lengths, nvirt = _pool_fixture(page, int8=int8)
+    ref = decode_attention(
+        q, _gather(k, pages, nvirt), _gather(v, pages, nvirt), lengths,
+        block_k=page,
+        k_scale=None if ks is None else _gather(ks, pages, nvirt),
+        v_scale=None if vs is None else _gather(vs, pages, nvirt))
+    out = paged_decode_attention(q, k, v, lengths, pages, layer=LAYER,
+                                 k_scale=ks, v_scale=vs)
+    np.testing.assert_array_equal(np.asarray(ref[:2]), np.asarray(out[:2]))
+
+
+def test_paged_decode_fused_write_pool_contents():
+    """The fused aliased write: the step's K/V row lands BITWISE at the
+    table-resolved (page, offset), every untouched pool page is bitwise
+    untouched (the dead lane's garbage stripe goes to trash page 0), and
+    the attend output matches the pre-scattered reference within the
+    fused kernel's score-column tolerance (VPU row-sum vs MXU dot —
+    the same bound the monolithic fused tests use)."""
+    page = 16
+    q, k, v, _, _, pages, lengths, nvirt = _pool_fixture(page)
+    rng = np.random.RandomState(7)
+    new_k = jnp.asarray(rng.randn(B, KVH, D), jnp.float32)
+    new_v = jnp.asarray(rng.randn(B, KVH, D), jnp.float32)
+    # reference: pre-scatter the row through the table, then attend
+    pos = jnp.maximum(lengths - 1, 0)
+    phys = pages[jnp.arange(B), pos // page]
+    off = pos % page
+    kw = k.at[LAYER, phys, off].set(new_k.reshape(B, KVHD))
+    vw = v.at[LAYER, phys, off].set(new_v.reshape(B, KVHD))
+    ref = decode_attention(q, _gather(kw, pages, nvirt),
+                           _gather(vw, pages, nvirt), lengths, block_k=page)
+    out, ko, vo = paged_decode_attention(q, k, v, lengths, pages,
+                                         layer=LAYER, new_k=new_k,
+                                         new_v=new_v)
+    np.testing.assert_allclose(np.asarray(ref[:2]), np.asarray(out[:2]),
+                               rtol=2e-5, atol=2e-5)
+    live = np.arange(2)                     # rows 0, 1 are live
+    np.testing.assert_array_equal(np.asarray(kw[LAYER, phys[live], off[live]]),
+                                  np.asarray(ko[LAYER, phys[live], off[live]]))
+    np.testing.assert_array_equal(np.asarray(vw[LAYER, phys[live], off[live]]),
+                                  np.asarray(vo[LAYER, phys[live], off[live]]))
+    untouched = np.setdiff1d(np.arange(k.shape[1]), np.asarray(phys))
+    np.testing.assert_array_equal(np.asarray(k[:, untouched]),
+                                  np.asarray(ko[:, untouched]))
+    np.testing.assert_array_equal(np.asarray(v[:, untouched]),
+                                  np.asarray(vo[:, untouched]))
+
+
+def test_paged_decode_fused_write_int8_quantizes_like_cache():
+    """Fused write on an int8 pool: the kernel's in-kernel quantization
+    of the fresh row (per-kv-head symmetric, max/127) writes the SAME
+    payload bytes and scales the out-of-kernel quantize-then-scatter
+    path would."""
+    page = 16
+    q, k, v, ks, vs, pages, lengths, _ = _pool_fixture(page, int8=True)
+    rng = np.random.RandomState(11)
+    new_k = jnp.asarray(rng.randn(B, KVH, D), jnp.float32)
+    new_v = jnp.asarray(rng.randn(B, KVH, D), jnp.float32)
+    out, ko, vo, kso, vso = paged_decode_attention(
+        q, k, v, lengths, pages, layer=LAYER, k_scale=ks, v_scale=vs,
+        new_k=new_k, new_v=new_v)
+    assert bool(jnp.all(jnp.isfinite(out[:2])))
+    pos = jnp.maximum(lengths - 1, 0)
+    phys = np.asarray(pages[jnp.arange(B), pos // page])
+    off = np.asarray(pos % page)
+    for b in range(2):                      # live rows only
+        row = np.asarray(new_k[b], np.float32)          # [KVH, D]
+        s = np.abs(row).max(axis=1, keepdims=True) / 127.0
+        s = np.where(s == 0.0, 1.0, s)
+        qrow = np.clip(np.round(row / s), -127, 127).astype(np.int8)
+        np.testing.assert_array_equal(
+            np.asarray(ko[LAYER, phys[b], off[b]]).reshape(KVH, D), qrow)
+        np.testing.assert_allclose(
+            np.asarray(kso[LAYER, phys[b], off[b]]), s[:, 0], rtol=1e-6)
+
+
+@pytest.mark.parametrize("int8", [False, True], ids=["fp32", "int8"])
+def test_paged_chunk_prefill_bitwise_vs_gather(int8):
+    """Chunked prefill over the pool == the monolithic chunk kernel over
+    the gathered view, bitwise — per-row starts including 0 and an
+    unaligned mid-page start."""
+    page = 16
+    q0, k, v, ks, vs, pages, _, nvirt = _pool_fixture(page, int8=int8)
+    del q0
+    C = 24
+    rng = np.random.RandomState(3)
+    qc = jnp.asarray(rng.randn(B, C, H, D), jnp.float32)
+    starts = jnp.asarray([13, 0, 0], jnp.int32)
+    ref = chunk_prefill_attention(
+        qc, _gather(k, pages, nvirt), _gather(v, pages, nvirt), starts,
+        block_k=page,
+        k_scale=None if ks is None else _gather(ks, pages, nvirt),
+        v_scale=None if vs is None else _gather(vs, pages, nvirt))
+    out = paged_chunk_prefill_attention(qc, k, v, starts, pages,
+                                        layer=LAYER, k_scale=ks, v_scale=vs)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
+def test_paged_chunk_prefill_4k_prompt_matches_dense_one_pass():
+    """A 4k-prompt tail chunk through the paged kernel == the dense
+    one-pass softmax over the full 4096-token history (the path 4k+
+    prompts used to OOM through): same numbers, never a [S, S] score
+    tensor.  Tolerance is fp32 online-softmax vs dense re-association."""
+    page, nvirt = 64, 64                    # 4096 virtual positions
+    S_virt, C = page * nvirt, 128
+    start = S_virt - C                      # the last prefill chunk
+    Hq, KVHq, Dq = 2, 1, 8
+    rng = np.random.RandomState(5)
+    k = jnp.asarray(rng.randn(1, nvirt + 1, page, KVHq * Dq), jnp.float32)
+    v = jnp.asarray(rng.randn(1, nvirt + 1, page, KVHq * Dq), jnp.float32)
+    pages = jnp.asarray(rng.permutation(nvirt) + 1, jnp.int32)[None]
+    qc = jnp.asarray(rng.randn(1, C, Hq, Dq), jnp.float32)
+    out = paged_chunk_prefill_attention(
+        qc, k, v, jnp.asarray([start], jnp.int32), pages, layer=0)
+    # dense one-pass reference over the gathered history, in float64 —
+    # plain loops keep it obviously correct
+    kv_g = k[0, pages[0]].reshape(S_virt, KVHq, Dq)
+    vv_g = v[0, pages[0]].reshape(S_virt, KVHq, Dq)
+    q_np = np.asarray(qc[0], np.float64)                 # [C, Hq, Dq]
+    k_np = np.asarray(kv_g, np.float64)                  # [S, KVHq, Dq]
+    v_np = np.asarray(vv_g, np.float64)
+    ref = np.zeros((C, Hq, Dq))
+    for h in range(Hq):
+        kh = k_np[:, h // (Hq // KVHq)]                  # GQA group share
+        vh = v_np[:, h // (Hq // KVHq)]
+        s = (q_np[:, h] / np.sqrt(Dq)) @ kh.T            # [C, S]
+        mask = np.arange(S_virt)[None, :] > (start + np.arange(C))[:, None]
+        s[mask] = -np.inf
+        p = np.exp(s - s.max(axis=1, keepdims=True))
+        ref[:, h] = (p / p.sum(axis=1, keepdims=True)) @ vh
+    np.testing.assert_allclose(np.asarray(out[0]), ref, rtol=2e-5,
+                               atol=2e-5)
+
+
+# --------------------------------------------------------------------- #
+# The registry: one static dispatch table, host attribution included
+# --------------------------------------------------------------------- #
+
+def test_registry_dispatch_table():
+    """The capability probes, in table order: paged decode only without
+    bias/window/opt-out; monolithic decode masks windows in-kernel; the
+    chunk kernel covers 1 < S <= MAX_CHUNK_S; everything else is the
+    reference fallback."""
+    assert select_kernel(s=1, paged=True) == "pallas_paged_decode"
+    assert select_kernel(s=1, paged=False) == "pallas_decode"
+    assert select_kernel(s=1, paged=False,
+                         has_window=True) == "pallas_decode"
+    assert select_kernel(s=1, paged=True,
+                         has_window=True) == "reference_fallback"
+    assert select_kernel(s=1, paged=True,
+                         disabled=True) == "reference_fallback"
+    assert select_kernel(s=1, paged=True,
+                         has_bias=True) == "reference_fallback"
+    for s in (2, 8, MAX_CHUNK_S):
+        assert select_kernel(s=s, paged=True) == "pallas_chunked_prefill"
+        assert select_kernel(s=s, paged=False) == "pallas_chunked_prefill"
+    assert select_kernel(s=MAX_CHUNK_S + 1,
+                         paged=True) == "reference_fallback"
+    # host-side attribution probes the SAME table
+    assert kernel_modes(paged=True) == {
+        "decode": "pallas_paged_decode",
+        "prefill_chunk": "pallas_chunked_prefill"}
+    assert kernel_modes(paged=True, disabled=True) == {
+        "decode": "reference_fallback",
+        "prefill_chunk": "reference_fallback"}
+    assert kernel_modes(paged=False) == {
+        "decode": "pallas_decode",
+        "prefill_chunk": "pallas_chunked_prefill"}
+
+
+def test_registry_backend_gate(monkeypatch):
+    """DSTPU_DISABLE_FLASH=1 drops every mode to the reference fallback —
+    the probe consults live backend capability, not a cached answer."""
+    monkeypatch.setenv("DSTPU_DISABLE_FLASH", "1")
+    assert select_kernel(s=1, paged=True) == "reference_fallback"
+    assert select_kernel(s=8, paged=False) == "reference_fallback"
+    monkeypatch.delenv("DSTPU_DISABLE_FLASH")
+    assert select_kernel(s=1, paged=True) == "pallas_paged_decode"
+
+
+def test_prefill_plan_reasons_name_kernel_modes():
+    """prefill_plan() reasons carry the registry attribution so bench
+    records say which kernel path actually ran."""
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models.transformer import (Transformer,
+                                                  TransformerConfig)
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                            num_heads=2, max_seq_len=4096)
+    eng = InferenceEngine(Transformer(cfg),
+                          DeepSpeedInferenceConfig(prefill_chunk_size="auto"))
+    mode, chunk, why = eng.prefill_plan(16, 4096)
+    assert mode == "chunked"
+    assert "prefill=pallas_chunked_prefill" in why
+    assert "decode=pallas_decode" in why
+    _, _, why_paged = eng.prefill_plan(16, 4096, paged=True)
+    assert "decode=pallas_paged_decode" in why_paged
+
+
+def test_paged_decode_jaxpr_callback_free_and_aliased():
+    """The traced paged decode step: no host callbacks anywhere in the
+    jaxpr, and the fused kernel's pool write is declared as
+    input_output_aliases on the pallas_call — the in-place pool update
+    the whole paged design rests on.  (The full entry-point donation
+    proof lives in the PROGRAMS.lock harness.)"""
+    page = 16
+    q, k, v, _, _, pages, lengths, _ = _pool_fixture(page)
+    rng = np.random.RandomState(13)
+    new_k = jnp.asarray(rng.randn(B, KVH, D), jnp.float32)
+    new_v = jnp.asarray(rng.randn(B, KVH, D), jnp.float32)
+    jaxpr = jax.make_jaxpr(
+        lambda *a: paged_decode_attention(*a, layer=LAYER, new_k=new_k,
+                                          new_v=new_v))(
+        q, k, v, lengths, pages)
+    text = str(jaxpr)
+    assert "callback" not in text
+    eqns = [e for e in jaxpr.jaxpr.eqns if e.primitive.name == "pallas_call"]
+    assert eqns, "paged decode did not lower to a pallas_call"
+    aliases = eqns[0].params.get("input_output_aliases")
+    assert aliases, "fused paged write lost its input/output aliasing"

@@ -393,3 +393,36 @@ def test_page_pool_and_prefix_index_unit():
     assert compact_page_str([4, 5, 6, 9, 2]) == "4-6,9,2"
     assert expand_page_str("4-6,9,2") == [4, 5, 6, 9, 2]
     assert compact_page_str([]) == "" and expand_page_str("") == []
+
+
+def test_paged_kernel_knob_ab_bitwise_and_fallback_counter():
+    """serving.paged_kernel=False is the A/B switch back to the
+    pre-kernel gather path: greedy outputs stay BITWISE-identical to the
+    kernel path (both match solo generate()), the engine's kernel_modes
+    attribution flips to reference_fallback, and every gather-path decode
+    dispatch is counted in stats["paged_attention_fallback"] (the kernel
+    path counts zero)."""
+    eng_on = _build_engine()
+    eng_off = _build_engine(serving={**PAGED, "paged_kernel": False})
+    rng = np.random.default_rng(31)
+    prompts, news = _mixed_workload(rng, n=5)
+    outs = {}
+    for tag, eng in (("on", eng_on), ("off", eng_off)):
+        srv = eng.serve()
+        assert srv.paged_kernel is (tag == "on")
+        want = ("pallas_paged_decode" if tag == "on"
+                else "reference_fallback")
+        assert srv.kernel_modes["decode"] == want
+        rids = [srv.submit(p, max_new_tokens=n)
+                for p, n in zip(prompts, news)]
+        res = srv.drain()
+        _assert_bitwise(eng, res, rids, prompts, news)
+        fb = srv.stats["paged_attention_fallback"]
+        if tag == "on":
+            assert fb == 0, fb
+        else:
+            assert fb == srv.stats["decode_calls"] > 0, fb
+        outs[tag] = [res[r] for r in rids]
+        srv.close()
+    for a, b in zip(outs["on"], outs["off"]):
+        np.testing.assert_array_equal(a, b)
